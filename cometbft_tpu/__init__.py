@@ -1,0 +1,20 @@
+"""cometbft_tpu — a TPU-native BFT consensus framework.
+
+A from-scratch rebuild of CometBFT's capability surface (Tendermint BFT
+consensus + ABCI + block/state sync + light client + JSON-RPC), redesigned as a
+two-tier system:
+
+- **Host tier** (Python/asyncio): consensus state machine, encrypted p2p
+  gossip, mempool, block/state stores, ABCI boundary, RPC. Control-flow heavy,
+  adversarial, latency-sensitive — kept on CPU, mirroring where the reference
+  spends control cycles (reference: consensus/state.go, p2p/, mempool/, ...).
+
+- **Device tier** (JAX/Pallas): the crypto hot path — ZIP-215 Ed25519 batch
+  signature verification and RFC-6962 SHA-256 Merkle hashing — as vectorized
+  TPU kernels behind the same `BatchVerifier` seam the reference uses
+  (reference: crypto/crypto.go:46-54), so commit verification
+  (types/validation.go), blocksync replay (blocksync/reactor.go:360) and
+  light-client bisection (light/verifier.go) ride the TPU.
+"""
+
+from cometbft_tpu.version import __version__  # noqa: F401

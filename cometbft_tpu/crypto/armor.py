@@ -1,0 +1,67 @@
+"""ASCII armor for key serialization (reference: crypto/armor/armor.go).
+
+OpenPGP-style armored blocks: header line, key/value headers, base64 body,
+CRC-24 checksum, footer.
+"""
+
+from __future__ import annotations
+
+import base64
+
+
+def _crc24(data: bytes) -> int:
+    crc = 0xB704CE
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= 0x1864CFB
+    return crc & 0xFFFFFF
+
+
+def encode_armor(block_type: str, headers: dict[str, str], data: bytes) -> str:
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k, v in headers.items():
+        lines.append(f"{k}: {v}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    for i in range(0, len(b64), 64):
+        lines.append(b64[i : i + 64])
+    crc = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
+    lines.append("=" + crc)
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> tuple[str, dict[str, str], bytes]:
+    lines = [ln for ln in armor_str.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN ") or not lines[0].endswith("-----"):
+        raise ValueError("invalid armor: missing BEGIN line")
+    block_type = lines[0][len("-----BEGIN ") : -len("-----")]
+    if not lines[-1] == f"-----END {block_type}-----":
+        raise ValueError("invalid armor: missing END line")
+    headers: dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i].strip():
+        if ":" not in lines[i]:
+            break
+        k, v = lines[i].split(":", 1)
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(lines) - 1 and not lines[i].strip():
+        i += 1
+    body_lines = []
+    crc_line = None
+    for ln in lines[i:-1]:
+        if ln.startswith("="):
+            crc_line = ln[1:]
+        else:
+            body_lines.append(ln)
+    data = base64.b64decode("".join(body_lines))
+    if crc_line is None:
+        raise ValueError("invalid armor: missing CRC-24 checksum line")
+    want = int.from_bytes(base64.b64decode(crc_line), "big")
+    if _crc24(data) != want:
+        raise ValueError("invalid armor: CRC mismatch")
+    return block_type, headers, data

@@ -1,0 +1,24 @@
+"""Key-type dispatch for batch verification (reference: crypto/batch/batch.go).
+
+Only ed25519 and sr25519 support batching (batch.go:11-32); bn254 does not —
+matching the fork's behavior.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import ed25519, sr25519
+
+
+def create_batch_verifier(pk: crypto.PubKey) -> crypto.BatchVerifier:
+    """batch.CreateBatchVerifier (batch.go:11-21)."""
+    if isinstance(pk, ed25519.PubKey):
+        return ed25519.BatchVerifier()
+    if isinstance(pk, sr25519.PubKey):
+        return sr25519.BatchVerifier()
+    raise ValueError("only ed25519 and sr25519 are supported")
+
+
+def supports_batch_verifier(pk: crypto.PubKey | None) -> bool:
+    """batch.SupportsBatchVerifier (batch.go:25-32)."""
+    return isinstance(pk, (ed25519.PubKey, sr25519.PubKey))

@@ -1,0 +1,164 @@
+"""Ed25519 key types with ZIP-215 verification (reference: crypto/ed25519/ed25519.go).
+
+Key layout matches the reference: PrivKey = 64 bytes (seed || pubkey)
+(ed25519.go:71-80), PubKey = 32 bytes, Signature = 64 bytes, address =
+SHA256-20(pubkey) (ed25519.go:162-168).
+
+Verification strategy (host tier): try the C-speed strict RFC 8032 verifier
+from `cryptography` first — its acceptance set is a subset of ZIP-215's — and
+only on rejection fall back to the pure-Python cofactored ZIP-215 check, so
+honest signatures verify at library speed while adversarial edge encodings
+still get exact ZIP-215 semantics (reference uses curve25519-voi with
+VerifyOptionsZIP_215, ed25519.go:27-29). Bulk verification goes through the
+TPU batch verifier instead (cometbft_tpu/ops/ed25519_kernel.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import ed25519_pure, tmhash
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIVATE_KEY_SIZE = 64
+SIGNATURE_SIZE = 64
+SEED_SIZE = 32
+
+PRIV_KEY_NAME = "tendermint/PrivKeyEd25519"
+PUB_KEY_NAME = "tendermint/PubKeyEd25519"
+
+# Expanded-pubkey verification cache analog (reference ed25519.go:31,56
+# cacheSize=4096): we cache parsed `cryptography` pubkey handles.
+_CACHE_SIZE = 4096
+_pubkey_cache: dict[bytes, Ed25519PublicKey] = {}
+
+
+def _cached_pubkey(pub: bytes) -> Ed25519PublicKey | None:
+    h = _pubkey_cache.get(pub)
+    if h is None:
+        try:
+            h = Ed25519PublicKey.from_public_bytes(pub)
+        except Exception:
+            return None
+        if len(_pubkey_cache) >= _CACHE_SIZE:
+            _pubkey_cache.pop(next(iter(_pubkey_cache)))
+        _pubkey_cache[pub] = h
+    return h
+
+
+class PubKey(crypto.PubKey):
+    def __init__(self, data: bytes):
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        if len(self._bytes) != PUB_KEY_SIZE:
+            raise ValueError("pubkey is incorrect size")
+        return tmhash.sum_truncated(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE or len(self._bytes) != PUB_KEY_SIZE:
+            return False
+        handle = _cached_pubkey(self._bytes)
+        if handle is not None:
+            try:
+                handle.verify(sig, msg)
+                return True
+            except InvalidSignature:
+                pass
+        # Fast path rejected: settle edge cases under exact ZIP-215 rules.
+        return ed25519_pure.verify_zip215(self._bytes, msg, sig)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def __repr__(self) -> str:
+        return f"PubKeyEd25519{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKey(crypto.PrivKey):
+    def __init__(self, data: bytes):
+        if len(data) != PRIVATE_KEY_SIZE:
+            raise ValueError(f"ed25519 privkey must be {PRIVATE_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._handle = Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._handle.sign(msg)
+
+    def pub_key(self) -> PubKey:
+        if not any(self._bytes[32:]):
+            raise ValueError("expected ed25519 PrivKey to include concatenated pubkey bytes")
+        return PubKey(self._bytes[32:])
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    """GenPrivKey (ed25519.go:124-135)."""
+    seed = crypto.c_random(SEED_SIZE)
+    return _from_seed(seed)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKey:
+    """GenPrivKeyFromSecret (ed25519.go:141-148): seed = SHA256(secret)."""
+    return _from_seed(hashlib.sha256(secret).digest())
+
+
+def _from_seed(seed: bytes) -> PrivKey:
+    handle = Ed25519PrivateKey.from_private_bytes(seed)
+    pub = handle.public_key().public_bytes_raw()
+    return PrivKey(seed + pub)
+
+
+class BatchVerifier(crypto.BatchVerifier):
+    """Ed25519 batch verification (ed25519.go:196-228).
+
+    Entries accumulate host-side; `verify()` dispatches the whole batch to the
+    configured backend (TPU sidecar by default when a device is present,
+    pure-CPU otherwise) — the same seam as the reference's
+    cachingVerifier.AddWithOptions + BatchVerifier.Verify.
+    """
+
+    def __init__(self):
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, key: crypto.PubKey, message: bytes, signature: bytes) -> None:
+        if not isinstance(key, PubKey):
+            raise TypeError("pubkey is not Ed25519")
+        pk = key.bytes()
+        if len(pk) != PUB_KEY_SIZE:
+            raise ValueError(
+                f"pubkey size is incorrect; expected: {PUB_KEY_SIZE}, got {len(pk)}"
+            )
+        if len(signature) != SIGNATURE_SIZE:
+            raise ValueError("invalid signature")
+        self._pubs.append(pk)
+        self._msgs.append(bytes(message))
+        self._sigs.append(bytes(signature))
+
+    def __len__(self) -> int:
+        return len(self._pubs)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from cometbft_tpu.sidecar.backend import get_backend
+
+        if not self._pubs:
+            return False, []
+        return get_backend().batch_verify(self._pubs, self._msgs, self._sigs)

@@ -1,0 +1,236 @@
+"""Pure-Python edwards25519 with ZIP-215 verification semantics.
+
+This is the framework's correctness anchor: the TPU batch kernel
+(cometbft_tpu/ops/ed25519_kernel.py) and the fast host path
+(cometbft_tpu/crypto/ed25519.py) are both tested against it.
+
+Semantics mirror the reference's verifier configuration
+(crypto/ed25519/ed25519.go:27-29: curve25519-voi with VerifyOptionsZIP_215):
+  - A and R encodings may be non-canonical (y >= p accepted);
+  - x=0 with sign bit 1 fails decoding (RFC 8032 §5.1.3 rule kept);
+  - s must be canonical (s < L);
+  - verification uses the cofactored equation [8][s]B = [8]R + [8][k]A.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+# Extended homogeneous coordinates (X, Y, Z, T) with x=X/Z, y=Y/Z, T=XY/Z.
+IDENTITY = (0, 1, 1, 0)
+
+# Base point
+_BY = (4 * pow(5, P - 2, P)) % P
+_BX = None  # set below
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """x from y via sqrt((y^2-1)/(d y^2+1)); None if no root or x=0 with sign=1."""
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    # candidate root of u/v: x = u v^3 (u v^7)^((p-5)/8)
+    x = (u * pow(v, 3, P)) % P * pow((u * pow(v, 7, P)) % P, (P - 5) // 8, P) % P
+    vxx = v * x % P * x % P
+    if vxx == u:
+        pass
+    elif vxx == (P - u) % P:
+        x = x * SQRT_M1 % P
+    else:
+        return None
+    if x == 0 and sign == 1:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+_BX = _recover_x(_BY, 0)
+BASE = (_BX, _BY, 1, _BX * _BY % P)
+
+
+def point_add(p1, p2):
+    """add-2008-hwcd-3 for a=-1 twisted Edwards (unified, complete)."""
+    X1, Y1, Z1, T1 = p1
+    X2, Y2, Z2, T2 = p2
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * D * T1 % P * T2 % P
+    Dd = 2 * Z1 * Z2 % P
+    E = B - A
+    F = Dd - C
+    G = Dd + C
+    H = B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_double(p):
+    return point_add(p, p)
+
+
+def point_neg(p):
+    X, Y, Z, T = p
+    return ((P - X) % P, Y, Z, (P - T) % P)
+
+
+def scalar_mult(k: int, p):
+    """Double-and-add; variable time (verification only, not secret-dependent)."""
+    q = IDENTITY
+    while k > 0:
+        if k & 1:
+            q = point_add(q, p)
+        p = point_double(p)
+        k >>= 1
+    return q
+
+
+def point_equal(p1, p2) -> bool:
+    X1, Y1, Z1, _ = p1
+    X2, Y2, Z2, _ = p2
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+def point_compress(p) -> bytes:
+    X, Y, Z, _ = p
+    zinv = pow(Z, P - 2, P)
+    x = X * zinv % P
+    y = Y * zinv % P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def point_decompress_zip215(s: bytes):
+    """Decompress allowing non-canonical y (ZIP-215 rule 1); None on failure."""
+    if len(s) != 32:
+        return None
+    enc = int.from_bytes(s, "little")
+    sign = enc >> 255
+    y = (enc & ((1 << 255) - 1)) % P  # non-canonical y >= p is reduced, not rejected
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def point_decompress_canonical(s: bytes):
+    """Strict RFC 8032 decoding: y must be canonical (< p)."""
+    if len(s) != 32:
+        return None
+    enc = int.from_bytes(s, "little")
+    sign = enc >> 255
+    y = enc & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def sha512_mod_l(*chunks: bytes) -> int:
+    h = hashlib.sha512()
+    for c in chunks:
+        h.update(c)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def secret_expand(seed: bytes) -> tuple[int, bytes]:
+    """RFC 8032 §5.1.5: clamped scalar + hash prefix from a 32-byte seed."""
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(seed: bytes) -> bytes:
+    a, _ = secret_expand(seed)
+    return point_compress(scalar_mult(a, BASE))
+
+
+def sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
+    """RFC 8032 §5.1.6."""
+    a, prefix = secret_expand(seed)
+    r = sha512_mod_l(prefix, msg)
+    R = scalar_mult(r, BASE)
+    Rs = point_compress(R)
+    k = sha512_mod_l(Rs, pub, msg)
+    s = (r + k * a) % L
+    return Rs + int.to_bytes(s, 32, "little")
+
+
+def verify_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Single-signature ZIP-215 verification (the acceptance set the TPU batch
+    kernel and the reference's verifier share)."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    A = point_decompress_zip215(pub)
+    if A is None:
+        return False
+    Rs = sig[:32]
+    R = point_decompress_zip215(Rs)
+    if R is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = sha512_mod_l(Rs, pub, msg)
+    # [8][s]B == [8]R + [8][k]A  ⇔  [8]([s]B - [k]A - R) == identity
+    sB = scalar_mult(s, BASE)
+    kA = scalar_mult(k, A)
+    diff = point_add(point_add(sB, point_neg(kA)), point_neg(R))
+    eight_diff = point_double(point_double(point_double(diff)))
+    return point_equal(eight_diff, IDENTITY)
+
+
+def batch_verify_zip215(
+    pubs: list[bytes], msgs: list[bytes], sigs: list[bytes], rand_bytes=None
+) -> tuple[bool, list[bool]]:
+    """Batch equation with 128-bit random coefficients; falls back to
+    per-signature verification to produce the validity vector on failure —
+    the (bool, []bool) contract of crypto.BatchVerifier (crypto/crypto.go:46)."""
+    import os
+
+    n = len(pubs)
+    assert len(msgs) == n and len(sigs) == n
+    if n == 0:
+        return False, []
+    entries = []
+    ok_shape = [True] * n
+    for i in range(n):
+        if len(sigs[i]) != 64 or len(pubs[i]) != 32:
+            ok_shape[i] = False
+            continue
+        A = point_decompress_zip215(pubs[i])
+        R = point_decompress_zip215(sigs[i][:32])
+        s = int.from_bytes(sigs[i][32:], "little")
+        if A is None or R is None or s >= L:
+            ok_shape[i] = False
+            continue
+        k = sha512_mod_l(sigs[i][:32], pubs[i], msgs[i])
+        entries.append((i, A, R, s, k))
+    if not all(ok_shape):
+        # Shape/decode failure: report per-signature results individually.
+        results = [
+            ok_shape[i] and verify_zip215(pubs[i], msgs[i], sigs[i]) for i in range(n)
+        ]
+        return all(results), results
+    # sum_i z_i (s_i B - R_i - k_i A_i) == identity (cofactored)
+    rb = rand_bytes or (lambda: os.urandom(16))
+    s_acc = 0
+    acc = IDENTITY
+    for (_, A, R, s, k) in entries:
+        z = int.from_bytes(rb(), "little") | 1
+        s_acc = (s_acc + z * s) % L
+        acc = point_add(acc, scalar_mult(z, point_add(R, scalar_mult(k % L, A))))
+    lhs = scalar_mult(s_acc, BASE)
+    diff = point_add(lhs, point_neg(acc))
+    eight_diff = point_double(point_double(point_double(diff)))
+    if point_equal(eight_diff, IDENTITY):
+        return True, [True] * n
+    results = [verify_zip215(pubs[i], msgs[i], sigs[i]) for i in range(n)]
+    return all(results), results

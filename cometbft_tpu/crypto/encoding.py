@@ -1,0 +1,69 @@
+"""proto ↔ key-object conversion (reference: crypto/encoding/codec.go).
+
+Wire schema: tendermint.crypto.PublicKey oneof {ed25519=1, secp256k1=2,
+bn254=3} (proto/tendermint/crypto/keys.proto; bn254 is the fork's addition).
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import bn254, ed25519, secp256k1
+from cometbft_tpu.wire import proto as wire
+
+
+def pub_key_to_proto(k: crypto.PubKey) -> bytes:
+    """PubKeyToProto (codec.go:22-48) → serialized tendermint.crypto.PublicKey."""
+    if isinstance(k, ed25519.PubKey):
+        return wire.field_bytes(1, k.bytes(), emit_default=True)
+    if isinstance(k, secp256k1.PubKey):
+        return wire.field_bytes(2, k.bytes(), emit_default=True)
+    if isinstance(k, bn254.PubKey):
+        return wire.field_bytes(3, k.bytes(), emit_default=True)
+    raise ValueError(f"toproto: key type {k} is not supported")
+
+
+def pub_key_from_proto(data: bytes) -> crypto.PubKey:
+    """PubKeyFromProto (codec.go:51-93)."""
+    fields = wire.decode_fields(data)
+    if 1 in fields:
+        raw = fields[1][-1]
+        if len(raw) != ed25519.PUB_KEY_SIZE:
+            raise ValueError(
+                f"invalid size for PubKeyEd25519. Got {len(raw)}, "
+                f"expected {ed25519.PUB_KEY_SIZE}"
+            )
+        return ed25519.PubKey(raw)
+    if 2 in fields:
+        raw = fields[2][-1]
+        if len(raw) != secp256k1.PUB_KEY_SIZE:
+            raise ValueError(
+                f"invalid size for PubKeySecp256k1. Got {len(raw)}, "
+                f"expected {secp256k1.PUB_KEY_SIZE}"
+            )
+        return secp256k1.PubKey(raw)
+    if 3 in fields:
+        raw = fields[3][-1]
+        if len(raw) != bn254.PUB_KEY_SIZE:
+            raise ValueError(
+                f"invalid size for PubKeyBN254. Got {len(raw)}, "
+                f"expected {bn254.PUB_KEY_SIZE}"
+            )
+        return bn254.PubKey(raw)
+    raise ValueError("fromproto: key type is not supported")
+
+
+_KEY_TYPE_TO_CLASS = {
+    ed25519.KEY_TYPE: (ed25519.PubKey, ed25519.PUB_KEY_SIZE),
+    secp256k1.KEY_TYPE: (secp256k1.PubKey, secp256k1.PUB_KEY_SIZE),
+    bn254.KEY_TYPE: (bn254.PubKey, bn254.PUB_KEY_SIZE),
+}
+
+
+def pub_key_from_type_and_bytes(key_type: str, raw: bytes) -> crypto.PubKey:
+    """Genesis/JSON path: construct a pubkey from its registered type name."""
+    if key_type not in _KEY_TYPE_TO_CLASS:
+        raise ValueError(f"unsupported key type {key_type}")
+    cls, size = _KEY_TYPE_TO_CLASS[key_type]
+    if len(raw) != size:
+        raise ValueError(f"invalid {key_type} pubkey size {len(raw)}, want {size}")
+    return cls(raw)

@@ -1,0 +1,39 @@
+"""RFC-6962 Merkle trees (reference: crypto/merkle/)."""
+
+from cometbft_tpu.crypto.merkle.hash import empty_hash, inner_hash, leaf_hash
+from cometbft_tpu.crypto.merkle.proof import (
+    MAX_AUNTS,
+    Proof,
+    compute_hash_from_aunts,
+    proofs_from_byte_slices,
+)
+from cometbft_tpu.crypto.merkle.proof_op import (
+    ProofOperator,
+    ProofOperators,
+    ProofRuntime,
+    default_proof_runtime,
+)
+from cometbft_tpu.crypto.merkle.proof_value import ValueOp
+from cometbft_tpu.crypto.merkle.tree import (
+    get_split_point,
+    hash_from_byte_slices,
+    hash_from_byte_slices_iterative,
+)
+
+__all__ = [
+    "MAX_AUNTS",
+    "Proof",
+    "ProofOperator",
+    "ProofOperators",
+    "ProofRuntime",
+    "ValueOp",
+    "compute_hash_from_aunts",
+    "default_proof_runtime",
+    "empty_hash",
+    "get_split_point",
+    "hash_from_byte_slices",
+    "hash_from_byte_slices_iterative",
+    "inner_hash",
+    "leaf_hash",
+    "proofs_from_byte_slices",
+]

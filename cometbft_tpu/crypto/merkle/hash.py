@@ -1,0 +1,26 @@
+"""RFC-6962 domain-separated hashing (reference: crypto/merkle/hash.go).
+
+leaf  = SHA256(0x00 || data)
+inner = SHA256(0x01 || left || right)
+empty = SHA256("")
+"""
+
+import hashlib
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def empty_hash() -> bytes:
+    """tmhash of the empty string (crypto/merkle/hash.go:16-18)."""
+    return hashlib.sha256(b"").digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    """SHA256(0x00 || leaf) (crypto/merkle/hash.go:21-23)."""
+    return hashlib.sha256(LEAF_PREFIX + leaf).digest()
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    """SHA256(0x01 || left || right) (crypto/merkle/hash.go:34-40)."""
+    return hashlib.sha256(INNER_PREFIX + left + right).digest()

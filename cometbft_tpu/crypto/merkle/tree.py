@@ -1,0 +1,61 @@
+"""RFC-6962 Merkle tree roots (reference: crypto/merkle/tree.go).
+
+The canonical tree splits at the largest power of two strictly less than the
+item count (tree.go:101-112). Pairing adjacent nodes level-by-level and
+promoting an odd trailing node produces the identical tree (tree.go:68-98
+proves this equivalence with a test) — we use the level-synchronous form both
+here and, vectorized, in the TPU kernel (cometbft_tpu/ops/merkle_kernel.py).
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.crypto.merkle.hash import empty_hash, inner_hash, leaf_hash
+
+
+def get_split_point(length: int) -> int:
+    """Largest power of 2 strictly less than length (crypto/merkle/tree.go:101)."""
+    if length < 1:
+        raise ValueError("Trying to split a tree with size < 1")
+    k = 1 << (length.bit_length() - 1)
+    if k == length:
+        k >>= 1
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Merkle root of items, RFC-6962 (crypto/merkle/tree.go:11-27).
+
+    Level-synchronous (iterative) so 64k+ leaf blocks don't hit Python
+    recursion limits; identical output to the reference's recursive split.
+    """
+    return hash_from_byte_slices_iterative(items)
+
+
+def hash_from_byte_slices_iterative(items: list[bytes]) -> bytes:
+    """crypto/merkle/tree.go:68-98."""
+    if len(items) == 0:
+        return empty_hash()
+    level = [leaf_hash(item) for item in items]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            nxt.append(inner_hash(level[i], level[i + 1]))
+        if len(level) % 2 == 1:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def hash_from_byte_slices_recursive(items: list[bytes]) -> bytes:
+    """Direct transliteration of the split-point recursion (tree.go:15-27);
+    kept for cross-checking the iterative form in tests."""
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    if n == 1:
+        return leaf_hash(items[0])
+    k = get_split_point(n)
+    return inner_hash(
+        hash_from_byte_slices_recursive(items[:k]),
+        hash_from_byte_slices_recursive(items[k:]),
+    )

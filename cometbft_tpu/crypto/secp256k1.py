@@ -1,0 +1,200 @@
+"""secp256k1 ECDSA for non-validator keys (reference: crypto/secp256k1/secp256k1.go).
+
+Pure-Python curve math (verification is not in the consensus hot path).
+Matches the reference contract: 33-byte compressed pubkeys, 64-byte R||S
+signatures with low-S enforcement on both sign and verify (the malleability
+check at secp256k1.go:204-215), RFC 6979 deterministic nonces (btcec behavior),
+message pre-hash SHA-256, and Bitcoin-style addresses
+RIPEMD160(SHA256(pubkey)) (secp256k1.go:155-167).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+from cometbft_tpu import crypto
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33
+PRIV_KEY_SIZE = 32
+SIGNATURE_LENGTH = 64
+
+PRIV_KEY_NAME = "tendermint/PrivKeySecp256k1"
+PUB_KEY_NAME = "tendermint/PubKeySecp256k1"
+
+# Curve parameters
+_P = 2**256 - 2**32 - 977
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_HALF_N = _N // 2
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, m - 2, m)
+
+
+def _point_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2:
+        if (y1 + y2) % _P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1, _P) % _P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, _P) % _P
+    x3 = (lam * lam - x1 - x2) % _P
+    y3 = (lam * (x1 - x3) - y1) % _P
+    return (x3, y3)
+
+
+def _scalar_mult(k: int, p):
+    r = None
+    while k > 0:
+        if k & 1:
+            r = _point_add(r, p)
+        p = _point_add(p, p)
+        k >>= 1
+    return r
+
+
+_G = (_GX, _GY)
+
+
+def _compress(p) -> bytes:
+    x, y = p
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decompress(b: bytes):
+    if len(b) != 33 or b[0] not in (2, 3):
+        return None
+    x = int.from_bytes(b[1:], "big")
+    if x >= _P:
+        return None
+    y2 = (pow(x, 3, _P) + 7) % _P
+    y = pow(y2, (_P + 1) // 4, _P)
+    if y * y % _P != y2:
+        return None
+    if y & 1 != b[0] & 1:
+        y = _P - y
+    return (x, y)
+
+
+def _rfc6979_nonce(privkey: int, msg_hash: bytes) -> int:
+    """Deterministic k per RFC 6979 with SHA-256."""
+    x = privkey.to_bytes(32, "big")
+    v = b"\x01" * 32
+    key = b"\x00" * 32
+    key = hmac.new(key, v + b"\x00" + x + msg_hash, hashlib.sha256).digest()
+    v = hmac.new(key, v, hashlib.sha256).digest()
+    key = hmac.new(key, v + b"\x01" + x + msg_hash, hashlib.sha256).digest()
+    v = hmac.new(key, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(key, v, hashlib.sha256).digest()
+        k = int.from_bytes(v, "big")
+        if 1 <= k < _N:
+            return k
+        key = hmac.new(key, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(key, v, hashlib.sha256).digest()
+
+
+class PubKey(crypto.PubKey):
+    def __init__(self, data: bytes):
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(pubkey)) (secp256k1.go:155-167)."""
+        sha = hashlib.sha256(self._bytes).digest()
+        h = hashlib.new("ripemd160")
+        h.update(sha)
+        return h.digest()
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """R||S, rejecting high-S (secp256k1.go:190-217)."""
+        if len(sig) != SIGNATURE_LENGTH:
+            return False
+        pub = _decompress(self._bytes)
+        if pub is None:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < _N and 1 <= s < _N):
+            return False
+        if s > _HALF_N:  # malleability check
+            return False
+        e = int.from_bytes(hashlib.sha256(msg).digest(), "big") % _N
+        w = _inv(s, _N)
+        u1 = e * w % _N
+        u2 = r * w % _N
+        pt = _point_add(_scalar_mult(u1, _G), _scalar_mult(u2, pub))
+        if pt is None:
+            return False
+        return pt[0] % _N == r
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+class PrivKey(crypto.PrivKey):
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._scalar = int.from_bytes(self._bytes, "big")
+        if not (1 <= self._scalar < _N):
+            raise ValueError("invalid secp256k1 scalar")
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte R||S with low-S normalization (secp256k1.go:135-146)."""
+        e_bytes = hashlib.sha256(msg).digest()
+        e = int.from_bytes(e_bytes, "big") % _N
+        k = _rfc6979_nonce(self._scalar, e_bytes)
+        while True:
+            pt = _scalar_mult(k, _G)
+            r = pt[0] % _N
+            if r != 0:
+                s = _inv(k, _N) * (e + r * self._scalar) % _N
+                if s != 0:
+                    break
+            k = (k + 1) % _N or 1
+        if s > _HALF_N:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKey:
+        return PubKey(_compress(_scalar_mult(self._scalar, _G)))
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    """secp256k1.go:76-103 (rejection sampling)."""
+    while True:
+        raw = os.urandom(PRIV_KEY_SIZE)
+        v = int.from_bytes(raw, "big")
+        if 1 <= v < _N:
+            return PrivKey(raw)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKey:
+    """secp256k1.go:106-118: seed = SHA256(secret), must be in range."""
+    seed = hashlib.sha256(secret).digest()
+    v = int.from_bytes(seed, "big")
+    if not (1 <= v < _N):
+        raise ValueError("secret was not compatible with secp256k1")
+    return PrivKey(seed)

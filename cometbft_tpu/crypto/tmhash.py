@@ -1,0 +1,22 @@
+"""SHA-256 wrappers with the 20-byte truncated variant.
+
+Reference: crypto/tmhash/hash.go (Size=32, TruncatedSize=20).
+"""
+
+import hashlib
+
+SIZE = 32
+BLOCK_SIZE = 64
+TRUNCATED_SIZE = 20
+
+
+def new():
+    return hashlib.sha256()
+
+
+def sum(bz: bytes) -> bytes:  # noqa: A001 - mirrors reference name tmhash.Sum
+    return hashlib.sha256(bz).digest()
+
+
+def sum_truncated(bz: bytes) -> bytes:
+    return hashlib.sha256(bz).digest()[:TRUNCATED_SIZE]

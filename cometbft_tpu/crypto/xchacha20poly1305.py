@@ -1,0 +1,68 @@
+"""XChaCha20-Poly1305 AEAD (reference: crypto/xchacha20poly1305/).
+
+HChaCha20 subkey derivation + standard ChaCha20-Poly1305 (via `cryptography`),
+24-byte nonces. Used for key armoring and symmetric encryption.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+KEY_SIZE = 32
+NONCE_SIZE = 24
+
+
+def _rotl32(v: int, c: int) -> int:
+    return ((v << c) | (v >> (32 - c))) & 0xFFFFFFFF
+
+
+def _quarter(state, a, b, c, d):
+    state[a] = (state[a] + state[b]) & 0xFFFFFFFF
+    state[d] = _rotl32(state[d] ^ state[a], 16)
+    state[c] = (state[c] + state[d]) & 0xFFFFFFFF
+    state[b] = _rotl32(state[b] ^ state[c], 12)
+    state[a] = (state[a] + state[b]) & 0xFFFFFFFF
+    state[d] = _rotl32(state[d] ^ state[a], 8)
+    state[c] = (state[c] + state[d]) & 0xFFFFFFFF
+    state[b] = _rotl32(state[b] ^ state[c], 7)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20: derive a subkey from key + first 16 nonce bytes."""
+    constants = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+    state = list(constants)
+    state += list(struct.unpack("<8I", key))
+    state += list(struct.unpack("<4I", nonce16))
+    for _ in range(10):
+        _quarter(state, 0, 4, 8, 12)
+        _quarter(state, 1, 5, 9, 13)
+        _quarter(state, 2, 6, 10, 14)
+        _quarter(state, 3, 7, 11, 15)
+        _quarter(state, 0, 5, 10, 15)
+        _quarter(state, 1, 6, 11, 12)
+        _quarter(state, 2, 7, 8, 13)
+        _quarter(state, 3, 4, 9, 14)
+    out = state[0:4] + state[12:16]
+    return struct.pack("<8I", *out)
+
+
+def seal(key: bytes, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+    if len(key) != KEY_SIZE:
+        raise ValueError("xchacha20poly1305: bad key length")
+    if len(nonce) != NONCE_SIZE:
+        raise ValueError("xchacha20poly1305: bad nonce length")
+    subkey = hchacha20(key, nonce[:16])
+    iv = b"\x00" * 4 + nonce[16:]
+    return ChaCha20Poly1305(subkey).encrypt(iv, plaintext, aad)
+
+
+def open_(key: bytes, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
+    if len(key) != KEY_SIZE:
+        raise ValueError("xchacha20poly1305: bad key length")
+    if len(nonce) != NONCE_SIZE:
+        raise ValueError("xchacha20poly1305: bad nonce length")
+    subkey = hchacha20(key, nonce[:16])
+    iv = b"\x00" * 4 + nonce[16:]
+    return ChaCha20Poly1305(subkey).decrypt(iv, ciphertext, aad)

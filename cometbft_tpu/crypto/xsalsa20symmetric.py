@@ -1,0 +1,40 @@
+"""Symmetric secretbox-style encryption (reference: crypto/xsalsa20symmetric/).
+
+The reference uses NaCl secretbox (XSalsa20-Poly1305) with a random 24-byte
+nonce prepended to the ciphertext. We keep the same envelope shape
+(nonce || sealed) but seal with XChaCha20-Poly1305 — an equally-strong AEAD
+from the same family — since the host crypto library does not expose XSalsa20.
+Decryption of reference-produced ciphertexts is a non-goal (these never cross
+the wire between implementations; they protect local key files).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cometbft_tpu.crypto import xchacha20poly1305
+
+NONCE_LEN = 24
+SECRET_LEN = 32
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """EncryptSymmetric (xsalsa20symmetric/symmetric.go:23-38)."""
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be of length: {SECRET_LEN}")
+    nonce = os.urandom(NONCE_LEN)
+    sealed = xchacha20poly1305.seal(secret, nonce, plaintext)
+    return nonce + sealed
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    """DecryptSymmetric (xsalsa20symmetric/symmetric.go:42-63)."""
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be of length: {SECRET_LEN}")
+    if len(ciphertext) <= NONCE_LEN + 16:
+        raise ValueError("ciphertext is too short")
+    nonce, sealed = ciphertext[:NONCE_LEN], ciphertext[NONCE_LEN:]
+    try:
+        return xchacha20poly1305.open_(secret, nonce, sealed)
+    except Exception as e:
+        raise ValueError("ciphertext decryption failed") from e

@@ -1,0 +1,3 @@
+"""The verification sidecar: pluggable crypto backends (CPU, in-process TPU,
+remote gRPC sidecar). This is the device-tier entry point selected through the
+`crypto.BatchVerifier` seam (reference: crypto/crypto.go:46-54)."""

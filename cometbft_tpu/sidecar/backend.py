@@ -1,0 +1,115 @@
+"""Crypto verification backends.
+
+Selection (env `CMTPU_BACKEND`, default `auto`):
+  - `cpu`:  host-only verification (C-speed single verifies + ZIP-215 fallback)
+  - `tpu`:  in-process JAX batch kernels (TPU when available, else XLA:CPU)
+  - `grpc`: remote verification sidecar over gRPC (cometbft_tpu/sidecar/service.py)
+  - `auto`: `tpu` when a JAX accelerator is visible, else `cpu`
+
+This mirrors where the reference chooses batch vs single verification
+(types/validation.go:14-16, 43-50): the caller keeps its fallback path, the
+backend only changes who executes the batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class VerifyBackend:
+    """Interface for the device tier."""
+
+    name = "abstract"
+
+    def batch_verify(
+        self, pubs: list[bytes], msgs: list[bytes], sigs: list[bytes]
+    ) -> tuple[bool, list[bool]]:
+        raise NotImplementedError
+
+    def merkle_root(self, leaves: list[bytes]) -> bytes:
+        raise NotImplementedError
+
+
+class CpuBackend(VerifyBackend):
+    """Host-tier fallback: per-signature verification, preserving the
+    (ok, per-sig bitmap) contract."""
+
+    name = "cpu"
+
+    def batch_verify(self, pubs, msgs, sigs):
+        from cometbft_tpu.crypto import ed25519
+
+        results = [
+            ed25519.PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+        return all(results), results
+
+    def merkle_root(self, leaves):
+        from cometbft_tpu.crypto.merkle import hash_from_byte_slices
+
+        return hash_from_byte_slices(leaves)
+
+
+class TpuBackend(VerifyBackend):
+    """In-process JAX batch kernels (cometbft_tpu/ops/*)."""
+
+    name = "tpu"
+
+    def __init__(self):
+        # Import lazily so host-only deployments never pay for JAX.
+        from cometbft_tpu.ops import ed25519_kernel, merkle_kernel
+
+        self._ed = ed25519_kernel
+        self._merkle = merkle_kernel
+
+    def batch_verify(self, pubs, msgs, sigs):
+        return self._ed.batch_verify(pubs, msgs, sigs)
+
+    def merkle_root(self, leaves):
+        return self._merkle.merkle_root(leaves)
+
+
+_backend: VerifyBackend | None = None
+_lock = threading.Lock()
+
+
+def _make_backend() -> VerifyBackend:
+    choice = os.environ.get("CMTPU_BACKEND", "auto").lower()
+    if choice == "cpu":
+        return CpuBackend()
+    if choice == "tpu":
+        return TpuBackend()
+    if choice == "grpc":
+        from cometbft_tpu.sidecar.service import GrpcBackend
+
+        return GrpcBackend(os.environ.get("CMTPU_SIDECAR_ADDR", "localhost:26670"))
+    if choice != "auto":
+        raise ValueError(f"unknown CMTPU_BACKEND {choice!r}")
+    # auto: prefer an accelerator if one is visible; fall back to CPU if the
+    # device tier can't initialize rather than failing the first verify call.
+    try:
+        import jax
+
+        if any(d.platform != "cpu" for d in jax.devices()):
+            return TpuBackend()
+    except Exception:
+        pass
+    return CpuBackend()
+
+
+def get_backend() -> VerifyBackend:
+    global _backend
+    if _backend is None:
+        with _lock:
+            if _backend is None:
+                _backend = _make_backend()
+    return _backend
+
+
+def set_backend(backend: VerifyBackend | None) -> None:
+    """Override the process-wide backend (tests, node bootstrap)."""
+    global _backend
+    with _lock:
+        _backend = backend

@@ -1,0 +1,7 @@
+"""Deterministic protobuf wire encoding.
+
+The reference's canonical byte formats (vote sign bytes, header field hashing,
+part-set headers, …) are protobuf messages serialized with gogoproto
+(reference: proto/tendermint/**, types/canonical.go). We hand-roll a minimal
+deterministic encoder so canonical bytes are bit-exact and dependency-free.
+"""

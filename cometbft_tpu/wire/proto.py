@@ -1,0 +1,205 @@
+"""Minimal deterministic protobuf encoder/decoder.
+
+Implements exactly the subset of proto3 wire format the canonical data
+structures need (reference wire types: proto/tendermint/**). Proto3 rules
+honored: default-valued scalar fields are omitted; fields are emitted in
+ascending field-number order; `bytes`/`string`/sub-messages are
+length-delimited; sfixed64 for canonical height/round (types/canonical.go).
+"""
+
+from __future__ import annotations
+
+import struct
+
+# Wire types
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_LEN = 2
+WT_FIXED32 = 5
+
+
+def encode_uvarint(n: int) -> bytes:
+    if n < 0:
+        raise ValueError("uvarint cannot encode negative")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_uvarint(buf: bytes, pos: int = 0) -> tuple[int, int]:
+    """Max 10 bytes / 64 bits, matching Go's binary.Uvarint and protobuf."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        if shift >= 70:
+            raise ValueError("varint too long")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            if result >= 1 << 64:
+                raise ValueError("varint overflows 64 bits")
+            return result, pos
+        shift += 7
+
+
+def encode_varint_signed(n: int) -> bytes:
+    """proto `int64`: negative values use 10-byte two's complement varint."""
+    if n < 0:
+        n += 1 << 64
+    return encode_uvarint(n)
+
+
+def decode_varint_signed(buf: bytes, pos: int = 0) -> tuple[int, int]:
+    v, pos = decode_uvarint(buf, pos)
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, pos
+
+
+def encode_zigzag(n: int) -> bytes:
+    """proto `sint64`."""
+    return encode_uvarint((n << 1) ^ (n >> 63))
+
+
+def tag(field_num: int, wire_type: int) -> bytes:
+    return encode_uvarint((field_num << 3) | wire_type)
+
+
+def field_varint(field_num: int, value: int, *, emit_default: bool = False) -> bytes:
+    if value == 0 and not emit_default:
+        return b""
+    return tag(field_num, WT_VARINT) + encode_varint_signed(value)
+
+
+def field_bool(field_num: int, value: bool, *, emit_default: bool = False) -> bytes:
+    if not value and not emit_default:
+        return b""
+    return tag(field_num, WT_VARINT) + (b"\x01" if value else b"\x00")
+
+
+def field_sfixed64(field_num: int, value: int, *, emit_default: bool = False) -> bytes:
+    if value == 0 and not emit_default:
+        return b""
+    return tag(field_num, WT_FIXED64) + struct.pack("<q", value)
+
+
+def field_fixed64(field_num: int, value: int, *, emit_default: bool = False) -> bytes:
+    if value == 0 and not emit_default:
+        return b""
+    return tag(field_num, WT_FIXED64) + struct.pack("<Q", value)
+
+
+def field_bytes(field_num: int, value: bytes, *, emit_default: bool = False) -> bytes:
+    if not value and not emit_default:
+        return b""
+    return tag(field_num, WT_LEN) + encode_uvarint(len(value)) + value
+
+
+def field_string(field_num: int, value: str, *, emit_default: bool = False) -> bytes:
+    return field_bytes(field_num, value.encode("utf-8"), emit_default=emit_default)
+
+
+def field_message(field_num: int, encoded: bytes | None, *, emit_empty: bool = False) -> bytes:
+    """A sub-message field. None ⇒ absent. Empty-encoded messages are still
+    emitted when emit_empty (gogoproto non-nullable semantics)."""
+    if encoded is None:
+        return b""
+    if not encoded and not emit_empty:
+        return b""
+    return tag(field_num, WT_LEN) + encode_uvarint(len(encoded)) + encoded
+
+
+def encode_bytes_len_prefixed(bz: bytes) -> bytes:
+    """uvarint length prefix + raw bytes (reference: types/encoding_helper.go
+    cdcEncode-style helpers / libs protoio delimited writing)."""
+    return encode_uvarint(len(bz)) + bz
+
+
+def length_delimited(encoded: bytes) -> bytes:
+    """Length-delimited framing of a full message (protoio.MarshalDelimited),
+    used for canonical vote/proposal sign bytes (types/vote.go VoteSignBytes)."""
+    return encode_uvarint(len(encoded)) + encoded
+
+
+# ---------------------------------------------------------------------------
+# Decoding: a tolerant field walker. Returns {field_num: [raw values]} where a
+# raw value is int (varint), bytes (len-delimited) or 8/4-byte packed.
+
+
+def decode_fields(buf: bytes) -> dict[int, list]:
+    fields: dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = decode_uvarint(buf, pos)
+        fnum, wt = key >> 3, key & 7
+        if wt == WT_VARINT:
+            val, pos = decode_uvarint(buf, pos)
+        elif wt == WT_FIXED64:
+            if pos + 8 > len(buf):
+                raise ValueError("truncated fixed64")
+            val = buf[pos : pos + 8]
+            pos += 8
+        elif wt == WT_LEN:
+            ln, pos = decode_uvarint(buf, pos)
+            if pos + ln > len(buf):
+                raise ValueError("truncated length-delimited field")
+            val = buf[pos : pos + ln]
+            pos += ln
+        elif wt == WT_FIXED32:
+            if pos + 4 > len(buf):
+                raise ValueError("truncated fixed32")
+            val = buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        fields.setdefault(fnum, []).append(val)
+    return fields
+
+
+def get_varint(fields: dict, num: int, default: int = 0) -> int:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    v = vals[-1]
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v
+
+
+def get_uvarint(fields: dict, num: int, default: int = 0) -> int:
+    vals = fields.get(num)
+    return vals[-1] if vals else default
+
+
+def get_bool(fields: dict, num: int) -> bool:
+    return bool(get_uvarint(fields, num, 0))
+
+
+def get_bytes(fields: dict, num: int, default: bytes = b"") -> bytes:
+    vals = fields.get(num)
+    return vals[-1] if vals else default
+
+
+def get_string(fields: dict, num: int, default: str = "") -> str:
+    vals = fields.get(num)
+    return vals[-1].decode("utf-8") if vals else default
+
+
+def get_sfixed64(fields: dict, num: int, default: int = 0) -> int:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    return struct.unpack("<q", vals[-1])[0]
+
+
+def get_repeated_bytes(fields: dict, num: int) -> list[bytes]:
+    return list(fields.get(num, []))

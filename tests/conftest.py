@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Force JAX onto the CPU backend with 8 virtual devices BEFORE jax import, so
+multi-chip sharding (jax.sharding.Mesh over 8 devices) is exercised without
+TPU hardware — the strategy the driver's dryrun_multichip also uses.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,143 @@
+"""Ed25519 tests (reference strategy: crypto/ed25519/ed25519_test.go):
+sign/verify round-trip, corruption, RFC 8032 vectors, ZIP-215 semantics,
+batch verifier contract."""
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519, ed25519_pure
+from cometbft_tpu.sidecar.backend import CpuBackend, set_backend
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_backend(CpuBackend())
+    yield
+    set_backend(None)
+
+
+def test_sign_verify_roundtrip():
+    priv = ed25519.gen_priv_key()
+    pub = priv.pub_key()
+    msg = b"hello tpu consensus"
+    sig = priv.sign(msg)
+    assert len(sig) == 64
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(b"other msg", sig)
+    bad = bytearray(sig)
+    bad[7] ^= 0x01
+    assert not pub.verify_signature(msg, bytes(bad))
+
+
+def test_rfc8032_vector_1():
+    # RFC 8032 §7.1 TEST 1 (empty message)
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    pub = bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    want_sig = bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert ed25519_pure.public_key(seed) == pub
+    assert ed25519_pure.sign(seed, pub, b"") == want_sig
+    priv = ed25519.PrivKey(seed + pub)
+    assert priv.sign(b"") == want_sig
+    assert priv.pub_key().bytes() == pub
+    assert priv.pub_key().verify_signature(b"", want_sig)
+    assert ed25519_pure.verify_zip215(pub, b"", want_sig)
+
+
+def test_rfc8032_vector_3():
+    seed = bytes.fromhex(
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+    )
+    pub = bytes.fromhex(
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+    )
+    msg = bytes.fromhex("af82")
+    want_sig = bytes.fromhex(
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+    )
+    assert ed25519_pure.sign(seed, pub, msg) == want_sig
+    assert ed25519.PubKey(pub).verify_signature(msg, want_sig)
+
+
+def test_gen_from_secret_deterministic():
+    a = ed25519.gen_priv_key_from_secret(b"a secret")
+    b = ed25519.gen_priv_key_from_secret(b"a secret")
+    assert a.bytes() == b.bytes()
+    assert a.pub_key().equals(b.pub_key())
+
+
+def test_address_is_sha256_20():
+    priv = ed25519.gen_priv_key_from_secret(b"addr test")
+    import hashlib
+
+    want = hashlib.sha256(priv.pub_key().bytes()).digest()[:20]
+    assert priv.pub_key().address() == want
+
+
+def test_zip215_accepts_noncanonical_y():
+    # A pubkey/R whose y-encoding is >= p must decode under ZIP-215 rules.
+    # Encoding of y = p (≡ 0): non-canonical representation of y=0.
+    enc = int.to_bytes(ed25519_pure.P, 32, "little")
+    assert ed25519_pure.point_decompress_zip215(enc) is not None
+    assert ed25519_pure.point_decompress_canonical(enc) is None
+
+
+def test_batch_verifier_all_valid():
+    n = 8
+    privs = [ed25519.gen_priv_key_from_secret(f"k{i}".encode()) for i in range(n)]
+    msgs = [f"msg {i} with distinct bytes".encode() for i in range(n)]
+    bv = ed25519.BatchVerifier()
+    for priv, msg in zip(privs, msgs):
+        bv.add(priv.pub_key(), msg, priv.sign(msg))
+    ok, results = bv.verify()
+    assert ok
+    assert results == [True] * n
+
+
+def test_batch_verifier_identifies_bad_sig():
+    n = 8
+    privs = [ed25519.gen_priv_key_from_secret(f"k{i}".encode()) for i in range(n)]
+    msgs = [f"msg {i}".encode() for i in range(n)]
+    bv = ed25519.BatchVerifier()
+    for i, (priv, msg) in enumerate(zip(privs, msgs)):
+        sig = priv.sign(msg)
+        if i == 3:
+            sig = bytes(64)  # garbage
+        bv.add(priv.pub_key(), msg, sig)
+    ok, results = bv.verify()
+    assert not ok
+    assert results == [i != 3 for i in range(n)]
+
+
+def test_batch_verifier_empty():
+    ok, results = ed25519.BatchVerifier().verify()
+    assert not ok
+    assert results == []
+
+
+def test_batch_verifier_rejects_wrong_key_type():
+    from cometbft_tpu.crypto import secp256k1
+
+    bv = ed25519.BatchVerifier()
+    k = secp256k1.gen_priv_key()
+    with pytest.raises(TypeError):
+        bv.add(k.pub_key(), b"m", bytes(64))
+
+
+def test_pure_batch_equation():
+    n = 4
+    seeds = [bytes([i]) * 32 for i in range(n)]
+    pubs = [ed25519_pure.public_key(s) for s in seeds]
+    msgs = [f"m{i}".encode() for i in range(n)]
+    sigs = [ed25519_pure.sign(s, p, m) for s, p, m in zip(seeds, pubs, msgs)]
+    ok, res = ed25519_pure.batch_verify_zip215(pubs, msgs, sigs)
+    assert ok and res == [True] * n
+    sigs[2] = sigs[2][:32] + bytes(32)
+    ok, res = ed25519_pure.batch_verify_zip215(pubs, msgs, sigs)
+    assert not ok and res == [True, True, False, True]

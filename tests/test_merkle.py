@@ -1,0 +1,102 @@
+"""Merkle tree tests, mirroring the reference's strategy
+(crypto/merkle/tree_test.go, proof_test.go): RFC-6962 vectors, recursive vs
+iterative equivalence, proof round-trips, tamper detection."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto.merkle import (
+    Proof,
+    compute_hash_from_aunts,
+    hash_from_byte_slices,
+    proofs_from_byte_slices,
+)
+from cometbft_tpu.crypto.merkle.hash import empty_hash, inner_hash, leaf_hash
+from cometbft_tpu.crypto.merkle.tree import (
+    get_split_point,
+    hash_from_byte_slices_recursive,
+)
+
+
+def test_empty_tree():
+    assert hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    assert empty_hash() == bytes.fromhex(
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+    )
+
+
+def test_single_leaf():
+    item = b"tendermint"
+    assert hash_from_byte_slices([item]) == hashlib.sha256(b"\x00" + item).digest()
+
+
+def test_rfc6962_leaf_domain_separation():
+    # leaf hash of empty leaf, from RFC 6962 test vectors
+    assert leaf_hash(b"") == bytes.fromhex(
+        "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d"
+    )
+
+
+def test_rfc6962_inner():
+    l, r = leaf_hash(b"N123"), leaf_hash(b"N456")
+    assert inner_hash(l, r) == hashlib.sha256(b"\x01" + l + r).digest()
+
+
+def test_split_point():
+    for n, want in [(1, 0), (2, 1), (3, 2), (4, 2), (5, 4), (10, 8), (20, 16), (100, 64), (255, 128), (256, 128), (257, 256)]:
+        if n == 1:
+            continue
+        assert get_split_point(n) == want, n
+
+
+def test_recursive_matches_iterative():
+    for n in [1, 2, 3, 4, 5, 6, 7, 8, 9, 33, 100, 255, 256, 257]:
+        items = [bytes([i % 256]) * (i % 7 + 1) for i in range(n)]
+        assert hash_from_byte_slices(items) == hash_from_byte_slices_recursive(items), n
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 100, 257])
+def test_proofs(n):
+    items = [f"item{i}".encode() for i in range(n)]
+    root, proofs = proofs_from_byte_slices(items)
+    assert root == hash_from_byte_slices(items)
+    for i, proof in enumerate(proofs):
+        assert proof.total == n
+        assert proof.index == i
+        proof.verify(root, items[i])
+        # wrong leaf fails
+        with pytest.raises(ValueError):
+            proof.verify(root, b"bogus")
+        # wrong root fails
+        with pytest.raises(ValueError):
+            proof.verify(b"\x00" * 32, items[i])
+
+
+def test_proof_tampered_aunts():
+    items = [f"item{i}".encode() for i in range(8)]
+    root, proofs = proofs_from_byte_slices(items)
+    for proof in proofs:
+        for j in range(len(proof.aunts)):
+            tampered = Proof(
+                total=proof.total,
+                index=proof.index,
+                leaf_hash=proof.leaf_hash,
+                aunts=[a if k != j else b"\x00" * 32 for k, a in enumerate(proof.aunts)],
+            )
+            with pytest.raises(ValueError):
+                tampered.verify(root, items[proof.index])
+
+
+def test_compute_hash_from_aunts_bad_shapes():
+    assert compute_hash_from_aunts(-1, 1, b"x" * 32, []) is None
+    assert compute_hash_from_aunts(1, 1, b"x" * 32, []) is None
+    assert compute_hash_from_aunts(0, 2, b"x" * 32, []) is None  # missing aunt
+    assert compute_hash_from_aunts(0, 1, b"x" * 32, [b"y" * 32]) is None  # extra
+
+
+def test_large_tree_no_recursion_error():
+    items = [i.to_bytes(4, "big") for i in range(4096)]
+    root, proofs = proofs_from_byte_slices(items)
+    proofs[0].verify(root, items[0])
+    proofs[4095].verify(root, items[4095])
