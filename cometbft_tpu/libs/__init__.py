@@ -1,0 +1,1 @@
+"""Shared library layer (reference: libs/)."""

@@ -1,0 +1,217 @@
+"""GF(2^255-19) arithmetic vectorized for TPU (device tier of crypto/ed25519).
+
+Representation: 17 little-endian limbs of radix 2^15, stacked int32[17, N]
+with the batch N in the TPU lane dimension. 17*15 = 255 exactly, so the
+wrap-around factor is just 19 (2^255 = 19 mod p) — no oversized fold
+constants. Limbs carry a LOOSE invariant: every public op returns limbs in
+[0, 2^15 + 95], which keeps all intermediates exact:
+
+  - products:       (2^15+95)^2           < 2^30.1  (int32, no overflow)
+  - split halves:   lo < 2^15, hi < 2^15.1 (exact in float32)
+  - column sums:    <= 34 * 2^15.1 < 2^20.2 (exact in float32 accumulation)
+  - 19-fold:        < 2^24.5              (int32)
+
+Carries are PARALLEL (shift-mask-roll over the limb axis), not sequential
+chains: two passes after a multiply, one after add/sub — the shape XLA fuses
+into a handful of vector ops. This is the TPU-native replacement for
+curve25519-voi's assembly field element (reference backend of
+crypto/ed25519/ed25519.go:27-29).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+LIMBS = 17
+LIMB_BITS = 15
+MASK = 0x7FFF
+
+P_INT = 2**255 - 19
+D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
+TWO_D_INT = (2 * D_INT) % P_INT
+SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """Python int -> int32[17] little-endian 15-bit limbs (host)."""
+    return np.array([(v >> (LIMB_BITS * i)) & MASK for i in range(LIMBS)], np.int32)
+
+
+def limbs_to_int(a) -> int:
+    """int32[17] (or [17,1]) -> Python int (host, for tests)."""
+    a = np.asarray(a).reshape(LIMBS)
+    return sum(int(a[i]) << (LIMB_BITS * i) for i in range(LIMBS))
+
+
+_P_LIMBS = [int(x) for x in int_to_limbs(P_INT)]
+# 4p per-limb: every limb >= 4*(2^15-19) > 2^15+95, so a - b + 4p stays
+# non-negative limb-wise under the loose invariant.
+_FOUR_P = np.array([4 * x for x in _P_LIMBS], np.int32).reshape(LIMBS, 1)
+
+# Wrap weights for the parallel carry: carry out of limb 16 re-enters limb 0
+# multiplied by 19 (2^255 = 19 mod p); all other carries shift up one limb.
+_WRAP = np.array([19] + [1] * (LIMBS - 1), np.int32).reshape(LIMBS, 1)
+
+
+def const_fe(v: int) -> jnp.ndarray:
+    """Field constant as int32[17, 1] (broadcasts over the batch)."""
+    return jnp.asarray(int_to_limbs(v).reshape(LIMBS, 1))
+
+
+def fe_from_bytes_le(b: np.ndarray) -> np.ndarray:
+    """uint8[N, 32] little-endian -> int32[17, N] limbs, using bits 0..254
+    (bit 255 — the point-compression sign — is dropped; extract it first)."""
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    bits = np.unpackbits(b, axis=1, bitorder="little")[:, :255]  # [N, 255]
+    pows = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
+    limbs = bits.reshape(-1, LIMBS, LIMB_BITS).astype(np.int32) @ pows  # [N, 17]
+    return np.ascontiguousarray(limbs.T)
+
+
+def fe_to_bytes_le(x) -> np.ndarray:
+    """int32[17, N] canonical limbs -> uint8[N, 32] (host)."""
+    a = np.asarray(x).T  # [N, 17]
+    bits = np.zeros((a.shape[0], 256), np.uint8)
+    for l in range(LIMBS):
+        for i in range(LIMB_BITS):
+            bits[:, l * LIMB_BITS + i] = (a[:, l] >> i) & 1
+    return np.packbits(bits, axis=1, bitorder="little")
+
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass: split each limb at 15 bits, shift carries up
+    one limb (top carry wraps to limb 0 with factor 19)."""
+    c = x >> LIMB_BITS
+    r = x & MASK
+    return r + jnp.roll(c, 1, axis=0) * jnp.asarray(_WRAP)
+
+
+def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """z = x*y mod p under the loose invariant. Schoolbook [17,17,N] product,
+    15-bit split, float32 column accumulation (exact: columns < 2^21),
+    19-fold, two parallel carry passes."""
+    p = x[None, :, :] * y[:, None, :]  # [j, i, N] int32, < 2^30.1
+    lo = (p & MASK).astype(jnp.float32)
+    hi = (p >> LIMB_BITS).astype(jnp.float32)
+    rows = []
+    for j in range(LIMBS):
+        rows.append(jnp.pad(lo[j], ((j, LIMBS - j), (0, 0))))       # col i+j
+        rows.append(jnp.pad(hi[j], ((j + 1, LIMBS - 1 - j), (0, 0))))  # col i+j+1
+    cols = jnp.sum(jnp.stack(rows), axis=0).astype(jnp.int32)  # [34, N]
+    folded = cols[:LIMBS] + 19 * cols[LIMBS:]
+    return _carry(_carry(folded))
+
+
+def fe_sq(x: jnp.ndarray) -> jnp.ndarray:
+    return fe_mul(x, x)
+
+
+def fe_add(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return _carry(x + y)
+
+
+def fe_sub(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return _carry(x + jnp.asarray(_FOUR_P) - y)
+
+
+def fe_neg(x: jnp.ndarray) -> jnp.ndarray:
+    return _carry(jnp.asarray(_FOUR_P) - x)
+
+
+def _seq_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential carry chain with wrap — tightens limbs to < 2^15 except a
+    tiny residue in limb 0; used only inside freeze."""
+    cols = [x[k] for k in range(LIMBS)]
+    out = []
+    c = None
+    for k in range(LIMBS):
+        t = cols[k] if c is None else cols[k] + c
+        out.append(t & MASK)
+        c = t >> LIMB_BITS
+    out[0] = out[0] + 19 * c
+    return jnp.stack(out)
+
+
+def fe_freeze(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical residue in [0, p). Two sequential passes bring the value
+    below 2^255 + 19; two conditional subtractions of p finish."""
+    x = _seq_carry(_seq_carry(x))
+    for _ in range(2):
+        cols = [x[k] - _P_LIMBS[k] for k in range(LIMBS)]
+        out = []
+        b = None
+        for k in range(LIMBS):
+            t = cols[k] if b is None else cols[k] + b
+            out.append(t & MASK)
+            b = t >> LIMB_BITS  # arithmetic shift: 0 or -1 (borrow)
+        ge = b == 0  # no final borrow -> x >= p -> keep subtracted form
+        x = jnp.stack([jnp.where(ge, out[k], x[k]) for k in range(LIMBS)])
+    return x
+
+
+def fe_is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: x == 0 mod p (freezes internally)."""
+    f = fe_freeze(x)
+    acc = f[0]
+    for k in range(1, LIMBS):
+        acc = acc | f[k]
+    return acc == 0
+
+
+def fe_eq(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return fe_is_zero(fe_sub(x, y))
+
+
+def fe_parity(x: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: least significant bit of the canonical residue."""
+    return (fe_freeze(x)[0] & 1) == 1
+
+
+def fe_select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """where(mask, a, b) with mask [N] broadcast over limbs."""
+    return jnp.where(mask[None, :], a, b)
+
+
+def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """n repeated squarings; rolled into fori_loop to bound program size."""
+    if n <= 4:
+        for _ in range(n):
+            x = fe_sq(x)
+        return x
+    return lax.fori_loop(0, n, lambda _, t: fe_sq(t), x)
+
+
+def fe_pow2523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3), the square-root exponent for point
+    decompression (crypto/ed25519 decoding). Standard 2^k-1 ladder chain."""
+    t0 = fe_sq(z)                      # z^2
+    t1 = fe_mul(z, _sq_n(t0, 2))       # z^9
+    t0 = fe_mul(t0, t1)                # z^11
+    t0 = fe_mul(t1, fe_sq(t0))         # z^31   = z^(2^5 - 1)
+    t0 = fe_mul(_sq_n(t0, 5), t0)      # 2^10 - 1
+    t1 = fe_mul(_sq_n(t0, 10), t0)     # 2^20 - 1
+    t2 = fe_mul(_sq_n(t1, 20), t1)     # 2^40 - 1
+    t1 = fe_mul(_sq_n(t2, 10), t0)     # 2^50 - 1
+    t2 = fe_mul(_sq_n(t1, 50), t1)     # 2^100 - 1
+    t2 = fe_mul(_sq_n(t2, 100), t2)    # 2^200 - 1
+    t1 = fe_mul(_sq_n(t2, 50), t1)     # 2^250 - 1
+    return fe_mul(_sq_n(t1, 2), z)     # 2^252 - 3
+
+
+def fe_invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255 - 21) via the same ladder (for point compression)."""
+    t0 = fe_sq(z)                      # z^2
+    t1 = fe_mul(z, _sq_n(t0, 2))       # z^9
+    t1b = fe_mul(t0, t1)               # z^11
+    t0 = fe_mul(t1, fe_sq(t1b))        # z^31
+    t0 = fe_mul(_sq_n(t0, 5), t0)      # 2^10 - 1
+    t1 = fe_mul(_sq_n(t0, 10), t0)     # 2^20 - 1
+    t2 = fe_mul(_sq_n(t1, 20), t1)     # 2^40 - 1
+    t1 = fe_mul(_sq_n(t2, 10), t0)     # 2^50 - 1
+    t2 = fe_mul(_sq_n(t1, 50), t1)     # 2^100 - 1
+    t2 = fe_mul(_sq_n(t2, 100), t2)    # 2^200 - 1
+    t1 = fe_mul(_sq_n(t2, 50), t1)     # 2^250 - 1
+    return fe_mul(_sq_n(t1, 5), t1b)   # 2^255 - 21

@@ -1,0 +1,95 @@
+"""Vectorized SHA-256 for TPU (device tier of crypto/tmhash + crypto/merkle).
+
+One compression call hashes N independent 64-byte blocks in SPMD lockstep:
+state and message words are uint32[·, N] with the batch in the lane
+dimension. uint32 adds wrap mod 2^32 natively, so the round function is
+exactly FIPS 180-4 with no emulation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_K = [
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+]
+
+IV = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def iv_state(n):
+    """Initial state broadcast to batch n: uint32[8, N]."""
+    return jnp.broadcast_to(
+        jnp.asarray(IV, jnp.uint32)[:, None], (8, n)
+    )
+
+
+def compress(state, words):
+    """One SHA-256 compression. state uint32[8, N], words uint32[16, N]."""
+    w = [words[i] for i in range(16)]
+    a, b, c, d, e, f, g, h = (state[i] for i in range(8))
+    for t in range(64):
+        if t >= 16:
+            s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
+            s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
+            w.append(w[t - 16] + s0 + w[t - 7] + s1)
+        big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + big_s1 + ch + jnp.uint32(_K[t]) + w[t]
+        big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = big_s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h])
+    return state + out
+
+
+def pack_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Host: SHA-256 pad N byte strings -> (uint32[B, 16, N] big-endian word
+    blocks, int32[N] block counts), B = max blocks over the batch."""
+    n = len(msgs)
+    nblocks = np.array([(len(m) + 8) // 64 + 1 for m in msgs], np.int32)
+    bmax = int(nblocks.max()) if n else 1
+    buf = np.zeros((n, bmax * 64), np.uint8)
+    for i, m in enumerate(msgs):
+        ln = len(m)
+        buf[i, :ln] = np.frombuffer(m, np.uint8)
+        buf[i, ln] = 0x80
+        bl = int(nblocks[i]) * 64
+        buf[i, bl - 8 : bl] = np.frombuffer(
+            (ln * 8).to_bytes(8, "big"), np.uint8
+        )
+    words = buf.reshape(n, bmax, 16, 4)
+    words = (
+        words[..., 0].astype(np.uint32) << 24
+        | words[..., 1].astype(np.uint32) << 16
+        | words[..., 2].astype(np.uint32) << 8
+        | words[..., 3].astype(np.uint32)
+    )  # [N, B, 16]
+    return np.ascontiguousarray(words.transpose(1, 2, 0)), nblocks
+
+
+def digest_words_to_bytes(words: np.ndarray) -> list[bytes]:
+    """uint32[8, N] -> N 32-byte big-endian digests (host)."""
+    w = np.asarray(words).T.astype(">u4")  # [N, 8]
+    flat = np.ascontiguousarray(w).view(np.uint8).reshape(w.shape[0], 32)
+    return [bytes(row) for row in flat]

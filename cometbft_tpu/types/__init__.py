@@ -1,0 +1,70 @@
+"""Core data types (reference: types/, 6,964 LoC surveyed in SURVEY.md §2.2)."""
+
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    BLOCK_PART_SIZE_BYTES,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    PROPOSAL_TYPE,
+    Block,
+    BlockID,
+    BlockMeta,
+    Commit,
+    CommitSig,
+    Consensus,
+    Data,
+    Header,
+    PartSetHeader,
+    SignedHeader,
+)
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    LightBlock,
+    LightClientAttackEvidence,
+)
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.params import ConsensusParams
+from cometbft_tpu.types.part_set import Part, PartSet
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.validation import (
+    Fraction,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+__all__ = [
+    "Block",
+    "BlockID",
+    "BlockMeta",
+    "Commit",
+    "CommitSig",
+    "Consensus",
+    "ConsensusParams",
+    "Data",
+    "DuplicateVoteEvidence",
+    "Fraction",
+    "GenesisDoc",
+    "GenesisValidator",
+    "Header",
+    "LightBlock",
+    "LightClientAttackEvidence",
+    "Part",
+    "PartSet",
+    "PartSetHeader",
+    "Proposal",
+    "SignedHeader",
+    "Time",
+    "Validator",
+    "ValidatorSet",
+    "Vote",
+    "verify_commit",
+    "verify_commit_light",
+    "verify_commit_light_trusting",
+]

@@ -1,0 +1,546 @@
+"""Block, Header, Commit, and BlockID (reference: types/block.go).
+
+Wire layouts follow proto/tendermint/types/types.proto; hashes follow the
+reference exactly: Header.Hash is the Merkle root over the 14
+protobuf-encoded header fields (types/block.go:440-475), Commit.Hash the
+root over proto-encoded CommitSigs (types/block.go:895-913), and the
+wrapper-value encoding of primitive fields mirrors cdcEncode
+(types/encoding_helper.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dfield
+
+from cometbft_tpu.crypto import merkle, tmhash
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.wire import proto as wire
+
+MAX_HEADER_BYTES = 626  # types/block.go MaxHeaderBytes
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+# Blocks are gossiped in parts of this size (types/params.go:20 BlockPartSizeBytes).
+BLOCK_PART_SIZE_BYTES = 65536
+
+MAX_COMMIT_OVERHEAD_BYTES = 94  # types/block.go MaxCommitOverheadBytes
+MAX_COMMIT_SIG_BYTES = 109  # types/block.go MaxCommitSigBytes
+
+
+def cdc_encode_bytes(b: bytes) -> bytes:
+    """cdcEncode for HexBytes: gogotypes.BytesValue{Value: b} or nil if empty
+    (types/encoding_helper.go)."""
+    if not b:
+        return b""
+    return wire.field_bytes(1, b)
+
+
+def cdc_encode_string(s: str) -> bytes:
+    if not s:
+        return b""
+    return wire.field_string(1, s)
+
+
+def cdc_encode_int64(v: int) -> bytes:
+    if v == 0:
+        return b""
+    return wire.field_varint(1, v)
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """tendermint.version.Consensus (proto/tendermint/version/types.proto)."""
+
+    block: int = 0
+    app: int = 0
+
+    def encode(self) -> bytes:
+        return wire.field_varint(1, self.block) + wire.field_varint(2, self.app)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Consensus":
+        f = wire.decode_fields(data)
+        return cls(wire.get_uvarint(f, 1), wire.get_uvarint(f, 2))
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def encode(self) -> bytes:
+        return wire.field_varint(1, self.total) + wire.field_bytes(2, self.hash)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PartSetHeader":
+        f = wire.decode_fields(data)
+        return cls(wire.get_uvarint(f, 1), wire.get_bytes(f, 2))
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError(
+                f"wrong Hash: expected size {tmhash.SIZE}, got {len(self.hash)}"
+            )
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = dfield(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        """Either an empty blockID (nil-vote) — types/block.go BlockID.IsZero."""
+        return len(self.hash) == 0 and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        return (
+            len(self.hash) == tmhash.SIZE
+            and self.part_set_header.total > 0
+            and len(self.part_set_header.hash) == tmhash.SIZE
+        )
+
+    def key(self) -> bytes:
+        """Map key: hash || proto(PartSetHeader) (types/block.go Key) — the
+        ordering basis for DuplicateVoteEvidence votes, so it must match the
+        reference byte-for-byte."""
+        return self.hash + self.part_set_header.encode()
+
+    def encode(self) -> bytes:
+        return wire.field_bytes(1, self.hash) + wire.field_message(
+            2, self.part_set_header.encode(), emit_empty=False
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockID":
+        f = wire.decode_fields(data)
+        return cls(
+            wire.get_bytes(f, 1), PartSetHeader.decode(wire.get_bytes(f, 2))
+        )
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError("wrong Hash")
+        self.part_set_header.validate_basic()
+
+
+@dataclass(frozen=True)
+class Header:
+    """types/block.go Header."""
+
+    version: Consensus = dfield(default_factory=Consensus)
+    chain_id: str = ""
+    height: int = 0
+    time: Time = dfield(default_factory=Time)
+    last_block_id: BlockID = dfield(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes | None:
+        """Merkle root over the 14 encoded fields (types/block.go:440-475).
+        None when ValidatorsHash is missing (header not yet complete)."""
+        if not self.validators_hash:
+            return None
+        return merkle.hash_from_byte_slices(
+            [
+                self.version.encode(),
+                cdc_encode_string(self.chain_id),
+                cdc_encode_int64(self.height),
+                self.time.encode(),
+                self.last_block_id.encode(),
+                cdc_encode_bytes(self.last_commit_hash),
+                cdc_encode_bytes(self.data_hash),
+                cdc_encode_bytes(self.validators_hash),
+                cdc_encode_bytes(self.next_validators_hash),
+                cdc_encode_bytes(self.consensus_hash),
+                cdc_encode_bytes(self.app_hash),
+                cdc_encode_bytes(self.last_results_hash),
+                cdc_encode_bytes(self.evidence_hash),
+                cdc_encode_bytes(self.proposer_address),
+            ]
+        )
+
+    def encode(self) -> bytes:
+        """proto Header (non-nullable version/time/last_block_id always emitted)."""
+        out = wire.field_message(1, self.version.encode(), emit_empty=True)
+        out += wire.field_string(2, self.chain_id)
+        out += wire.field_varint(3, self.height)
+        out += wire.field_message(4, self.time.encode(), emit_empty=True)
+        out += wire.field_message(5, self.last_block_id.encode(), emit_empty=True)
+        out += wire.field_bytes(6, self.last_commit_hash)
+        out += wire.field_bytes(7, self.data_hash)
+        out += wire.field_bytes(8, self.validators_hash)
+        out += wire.field_bytes(9, self.next_validators_hash)
+        out += wire.field_bytes(10, self.consensus_hash)
+        out += wire.field_bytes(11, self.app_hash)
+        out += wire.field_bytes(12, self.last_results_hash)
+        out += wire.field_bytes(13, self.evidence_hash)
+        out += wire.field_bytes(14, self.proposer_address)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        f = wire.decode_fields(data)
+        return cls(
+            version=Consensus.decode(wire.get_bytes(f, 1)),
+            chain_id=wire.get_string(f, 2),
+            height=wire.get_varint(f, 3),
+            time=Time.decode(wire.get_bytes(f, 4)),
+            last_block_id=BlockID.decode(wire.get_bytes(f, 5)),
+            last_commit_hash=wire.get_bytes(f, 6),
+            data_hash=wire.get_bytes(f, 7),
+            validators_hash=wire.get_bytes(f, 8),
+            next_validators_hash=wire.get_bytes(f, 9),
+            consensus_hash=wire.get_bytes(f, 10),
+            app_hash=wire.get_bytes(f, 11),
+            last_results_hash=wire.get_bytes(f, 12),
+            evidence_hash=wire.get_bytes(f, 13),
+            proposer_address=wire.get_bytes(f, 14),
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:376-432."""
+        if len(self.chain_id) > 50:
+            raise ValueError("chainID is too long")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.height == 0:
+            raise ValueError("zero Height")
+        self.last_block_id.validate_basic()
+        _validate_hash(self.last_commit_hash, "LastCommitHash")
+        _validate_hash(self.data_hash, "DataHash")
+        _validate_hash(self.evidence_hash, "EvidenceHash")
+        if len(self.proposer_address) not in (0, tmhash.TRUNCATED_SIZE):
+            raise ValueError("invalid ProposerAddress length")
+        _validate_hash(self.validators_hash, "ValidatorsHash")
+        _validate_hash(self.next_validators_hash, "NextValidatorsHash")
+        _validate_hash(self.consensus_hash, "ConsensusHash")
+        _validate_hash(self.last_results_hash, "LastResultsHash")
+
+
+def _validate_hash(h: bytes, name: str) -> None:
+    """types/validation.go ValidateHash: empty or tmhash.Size."""
+    if h and len(h) != tmhash.SIZE:
+        raise ValueError(
+            f"wrong {name}: expected size {tmhash.SIZE}, got {len(h)}"
+        )
+
+
+@dataclass(frozen=True)
+class CommitSig:
+    """types/block.go:575-660."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp: Time = dfield(default_factory=Time)
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls(block_id_flag=BLOCK_ID_FLAG_ABSENT)
+
+    @classmethod
+    def for_block(cls, addr: bytes, ts: Time, sig: bytes) -> "CommitSig":
+        return cls(BLOCK_ID_FLAG_COMMIT, addr, ts, sig)
+
+    def is_absent(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def for_block_flag(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this sig endorses (types/block.go:680-695)."""
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def encode(self) -> bytes:
+        out = wire.field_varint(1, self.block_id_flag)
+        out += wire.field_bytes(2, self.validator_address)
+        out += wire.field_message(3, self.timestamp.encode(), emit_empty=True)
+        out += wire.field_bytes(4, self.signature)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CommitSig":
+        f = wire.decode_fields(data)
+        return cls(
+            block_id_flag=wire.get_uvarint(f, 1),
+            validator_address=wire.get_bytes(f, 2),
+            timestamp=Time.decode(wire.get_bytes(f, 3)),
+            signature=wire.get_bytes(f, 4),
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:700-740."""
+        if self.block_id_flag not in (
+            BLOCK_ID_FLAG_ABSENT,
+            BLOCK_ID_FLAG_COMMIT,
+            BLOCK_ID_FLAG_NIL,
+        ):
+            raise ValueError(f"unknown BlockIDFlag: {self.block_id_flag}")
+        if self.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            if self.validator_address:
+                raise ValueError("validator address is present for absent CommitSig")
+            if not self.timestamp.is_zero():
+                raise ValueError("time is present for absent CommitSig")
+            if self.signature:
+                raise ValueError("signature is present for absent CommitSig")
+        else:
+            if len(self.validator_address) != tmhash.TRUNCATED_SIZE:
+                raise ValueError("expected ValidatorAddress size to be 20 bytes")
+            if not self.signature:
+                raise ValueError("signature is missing")
+            if len(self.signature) > MAX_SIGNATURE_SIZE:
+                raise ValueError("signature is too big")
+
+
+MAX_SIGNATURE_SIZE = 96  # types/signable.go MaxSignatureSize (bn254 G2 = 96)
+
+
+@dataclass
+class Commit:
+    """types/block.go:745-930."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = dfield(default_factory=BlockID)
+    signatures: list = dfield(default_factory=list)
+    _hash: bytes | None = dfield(default=None, compare=False, repr=False)
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [cs.encode() for cs in self.signatures]
+            )
+        return self._hash
+
+    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+        """Reconstruct the canonical signed vote of validator val_idx
+        (types/block.go:785-813) — per-sig timestamps make every batch entry
+        distinct message bytes."""
+        from cometbft_tpu.types import canonical
+
+        cs = self.signatures[val_idx]
+        return canonical.vote_sign_bytes_from_parts(
+            chain_id,
+            PRECOMMIT_TYPE,
+            self.height,
+            self.round,
+            cs.block_id(self.block_id),
+            cs.timestamp,
+        )
+
+    def encode(self) -> bytes:
+        out = wire.field_varint(1, self.height)
+        out += wire.field_varint(2, self.round)
+        out += wire.field_message(3, self.block_id.encode(), emit_empty=True)
+        for cs in self.signatures:
+            out += wire.field_message(4, cs.encode(), emit_empty=True)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        f = wire.decode_fields(data)
+        return cls(
+            height=wire.get_varint(f, 1),
+            round=wire.get_varint(f, 2),
+            block_id=BlockID.decode(wire.get_bytes(f, 3)),
+            signatures=[CommitSig.decode(b) for b in wire.get_repeated_bytes(f, 4)],
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:860-893."""
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for i, cs in enumerate(self.signatures):
+                try:
+                    cs.validate_basic()
+                except ValueError as e:
+                    raise ValueError(f"wrong CommitSig #{i}: {e}") from e
+
+
+# SignedMsgType values (proto/tendermint/types/types.proto).
+UNKNOWN_TYPE = 0
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+@dataclass
+class Data:
+    """Block transactions (types/block.go Data)."""
+
+    txs: list = dfield(default_factory=list)
+    _hash: bytes | None = dfield(default=None, compare=False, repr=False)
+
+    def hash(self) -> bytes:
+        from cometbft_tpu.types.tx import txs_hash
+
+        if self._hash is None:
+            self._hash = txs_hash(self.txs)
+        return self._hash
+
+    def encode(self) -> bytes:
+        out = b""
+        for tx in self.txs:
+            out += wire.field_bytes(1, tx, emit_default=True)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Data":
+        f = wire.decode_fields(data)
+        return cls(txs=wire.get_repeated_bytes(f, 1))
+
+
+@dataclass
+class Block:
+    """types/block.go:43-170."""
+
+    header: Header = dfield(default_factory=Header)
+    data: Data = dfield(default_factory=Data)
+    evidence: list = dfield(default_factory=list)  # list of Evidence
+    last_commit: Commit | None = None
+    _hash: bytes | None = dfield(default=None, compare=False, repr=False)
+
+    def hash(self) -> bytes | None:
+        """Header hash (types/block.go:123)."""
+        if self.last_commit is None and self.header.height > 1:
+            return None
+        return self.header.hash()
+
+    def validate_basic(self) -> None:
+        """Re-derives LastCommitHash/DataHash/EvidenceHash (types/block.go:56-107)."""
+        self.header.validate_basic()
+        if self.header.height > 1:
+            if self.last_commit is None:
+                raise ValueError("nil LastCommit")
+            self.last_commit.validate_basic()
+        if self.last_commit is not None:
+            if self.header.last_commit_hash != self.last_commit.hash():
+                raise ValueError("wrong Header.LastCommitHash")
+        elif self.header.last_commit_hash:
+            raise ValueError("wrong Header.LastCommitHash")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("wrong Header.DataHash")
+        from cometbft_tpu.types.evidence import evidence_list_hash
+
+        if self.header.evidence_hash != evidence_list_hash(self.evidence):
+            raise ValueError("wrong Header.EvidenceHash")
+
+    def encode(self) -> bytes:
+        from cometbft_tpu.types.evidence import encode_evidence_list
+
+        out = wire.field_message(1, self.header.encode(), emit_empty=True)
+        out += wire.field_message(2, self.data.encode(), emit_empty=True)
+        out += wire.field_message(3, encode_evidence_list(self.evidence), emit_empty=True)
+        if self.last_commit is not None:
+            out += wire.field_message(4, self.last_commit.encode(), emit_empty=True)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        from cometbft_tpu.types.evidence import decode_evidence_list
+
+        f = wire.decode_fields(data)
+        last_commit = None
+        if 4 in f:
+            last_commit = Commit.decode(wire.get_bytes(f, 4))
+        return cls(
+            header=Header.decode(wire.get_bytes(f, 1)),
+            data=Data.decode(wire.get_bytes(f, 2)),
+            evidence=decode_evidence_list(wire.get_bytes(f, 3)),
+            last_commit=last_commit,
+        )
+
+    def make_part_set(self, part_size: int = BLOCK_PART_SIZE_BYTES):
+        from cometbft_tpu.types.part_set import PartSet
+
+        return PartSet.from_data(self.encode(), part_size)
+
+
+@dataclass(frozen=True)
+class BlockMeta:
+    """types/block_meta.go."""
+
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int
+
+    def encode(self) -> bytes:
+        out = wire.field_message(1, self.block_id.encode(), emit_empty=True)
+        out += wire.field_varint(2, self.block_size)
+        out += wire.field_message(3, self.header.encode(), emit_empty=True)
+        out += wire.field_varint(4, self.num_txs)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        f = wire.decode_fields(data)
+        return cls(
+            block_id=BlockID.decode(wire.get_bytes(f, 1)),
+            block_size=wire.get_varint(f, 2),
+            header=Header.decode(wire.get_bytes(f, 3)),
+            num_txs=wire.get_varint(f, 4),
+        )
+
+
+@dataclass(frozen=True)
+class SignedHeader:
+    """types/light.go SignedHeader: header + its commit."""
+
+    header: Header
+    commit: Commit
+
+    def encode(self) -> bytes:
+        return wire.field_message(1, self.header.encode(), emit_empty=True) + (
+            wire.field_message(2, self.commit.encode(), emit_empty=True)
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedHeader":
+        f = wire.decode_fields(data)
+        return cls(
+            Header.decode(wire.get_bytes(f, 1)), Commit.decode(wire.get_bytes(f, 2))
+        )
+
+    def validate_basic(self, chain_id: str) -> None:
+        """types/light.go SignedHeader.ValidateBasic."""
+        if self.header is None:
+            raise ValueError("missing header")
+        if self.commit is None:
+            raise ValueError("missing commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header belongs to another chain {self.header.chain_id!r}, not {chain_id!r}"
+            )
+        if self.header.height != self.commit.height:
+            raise ValueError("header and commit height mismatch")
+        hhash = self.header.hash()
+        if hhash != self.commit.block_id.hash:
+            raise ValueError("commit signs block which doesn't match the header")

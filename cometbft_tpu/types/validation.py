@@ -1,0 +1,236 @@
+"""Commit verification engines (reference: types/validation.go).
+
+The three modes share two engines: batch (routes whole commits to the TPU
+device tier through crypto.batch) and single (per-signature host verify).
+Semantics mirror the reference exactly, including which signatures are
+ignored vs counted per mode and the batch→single relationship (the device
+path returns the per-sig bitmap directly, so the "first bad signature"
+error is produced without re-verification).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.types.block import BlockID, Commit, CommitSig
+
+BATCH_VERIFY_THRESHOLD = 2  # types/validation.go:12
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """libs/math.Fraction (trust level, e.g. 1/3)."""
+
+    numerator: int
+    denominator: int
+
+
+class ErrNotEnoughVotingPowerSigned(Exception):
+    def __init__(self, got: int, needed: int):
+        self.got = got
+        self.needed = needed
+        super().__init__(
+            f"invalid commit -- insufficient voting power: got {got}, needed more than {needed}"
+        )
+
+
+class ErrInvalidCommitHeight(Exception):
+    def __init__(self, expected: int, actual: int):
+        super().__init__(
+            f"Invalid commit -- wrong height: {expected} vs {actual}"
+        )
+
+
+class ErrInvalidCommitSignatures(Exception):
+    def __init__(self, expected: int, actual: int):
+        super().__init__(
+            f"Invalid commit -- wrong set size: {expected} vs {actual}"
+        )
+
+
+def _should_batch_verify(vals, commit: Commit) -> bool:
+    return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and (
+        crypto_batch.supports_batch_verifier(vals.get_proposer().pub_key)
+    )
+
+
+def verify_commit(chain_id: str, vals, block_id: BlockID, height: int, commit: Commit) -> None:
+    """+2/3 signed AND all signatures valid (types/validation.go:25-51).
+    Checks every signature: apps may reward precommit inclusion."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.is_absent()
+    count = lambda c: c.for_block_flag()
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+        )
+
+
+def verify_commit_light(
+    chain_id: str, vals, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """+2/3 signed; stops counting at quorum (types/validation.go:59-84)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: not c.for_block_flag()
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+        )
+
+
+def verify_commit_light_trusting(
+    chain_id: str, vals, commit: Commit, trust_level: Fraction
+) -> None:
+    """trustLevel of a (possibly different) validator set signed this commit
+    (types/validation.go:94-135); lookups are by address."""
+    from cometbft_tpu.types.validator_set import safe_mul
+
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    total_mul, overflow = safe_mul(vals.total_voting_power(), trust_level.numerator)
+    if overflow:
+        raise OverflowError(
+            "int64 overflow while calculating voting power needed. please provide "
+            "smaller trustLevel numerator"
+        )
+    voting_power_needed = total_mul // trust_level.denominator
+    ignore = lambda c: not c.for_block_flag()
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """types/validation.go:152-256 — the TPU call site."""
+    try:
+        bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    except ValueError:
+        bv = None
+    if bv is None or len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        raise ValueError(
+            "unsupported signature algorithm or insufficient signatures for batch verification"
+        )
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+    tallied = 0
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(tallied, voting_power_needed)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            sig = commit.signatures[idx]
+            raise ValueError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+            )
+    raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """types/validation.go:265-340."""
+    seen_vals: dict[int, int] = {}
+    tallied = 0
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(vote_sign_bytes, commit_sig.signature):
+            raise ValueError(
+                f"wrong signature (#{idx}): {commit_sig.signature.hex().upper()}"
+            )
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(tallied, voting_power_needed)
+
+
+def _verify_basic_vals_and_commit(vals, commit, height: int, block_id: BlockID) -> None:
+    """types/validation.go:342-365."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if commit is None:
+        raise ValueError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise ErrInvalidCommitSignatures(vals.size(), len(commit.signatures))
+    if height != commit.height:
+        raise ErrInvalidCommitHeight(height, commit.height)
+    if block_id != commit.block_id:
+        raise ValueError(
+            f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+        )

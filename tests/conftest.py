@@ -3,12 +3,17 @@
 Force JAX onto the CPU backend with 8 virtual devices BEFORE jax import, so
 multi-chip sharding (jax.sharding.Mesh over 8 devices) is exercised without
 TPU hardware — the strategy the driver's dryrun_multichip also uses.
+
+NOTE: the host environment pre-sets JAX_PLATFORMS=axon (the TPU tunnel), so
+we must OVERWRITE (not setdefault) and also pin jax.config after import —
+the env-only override has been observed to still initialize the axon plugin
+(which hangs when the tunnel is busy).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +21,7 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
