@@ -1,0 +1,69 @@
+"""Round benchmark: the north-star configs from BASELINE.md on the real chip.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Headline metric: wall time to verify a 10,240-signature commit (10k-validator
+VerifyCommitLight analog: ZIP-215 batch verification on device) PLUS the
+64k-leaf block Merkle root — the full "verify a block's crypto" step.
+
+vs_baseline: the reference's Go path cost for the same work, derived from its
+published numbers (BASELINE.md): RFC-6962 Merkle at 77.7 us / 100 leaves
+(crypto/merkle/tree.go:42) scales to ~50.9 ms for 64k leaves; curve25519-voi
+batch verification runs ~2x single-verify throughput (crypto/ed25519
+bench shapes), i.e. ~32 us/sig on server cores -> ~327 ms for 10,240 sigs.
+Baseline total: ~378 ms. vs_baseline = baseline_ms / measured_ms (>1 = faster
+than the reference path).
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    # Run on the default platform (TPU under axon; CPU elsewhere). The
+    # verification workload is packed host-side exactly as production does.
+    import jax
+    import numpy as np
+
+    from cometbft_tpu.ops import merkle_kernel as mk
+    from cometbft_tpu.ops.sharded import make_example_batch
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    n_sigs = 10240
+    n_leaves = 65536
+
+    operands = tuple(np.asarray(o) for o in make_example_batch(n_sigs))
+    verify = ek._compiled(n_sigs)
+    txs = [b"bench-tx-%08d" % i for i in range(n_leaves)]
+
+    # Warmup / compile.
+    ok = np.asarray(jax.block_until_ready(verify(*operands)))
+    assert ok.all(), "bench batch must verify"
+    mk.merkle_root(txs[:1024])
+
+    # Timed: 10,240-sig verify + 64k-leaf merkle root (3 reps, min).
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(verify(*operands))
+        mk.merkle_root(txs)
+        best = min(best, time.perf_counter() - t0)
+
+    measured_ms = best * 1000.0
+    baseline_ms = 10240 * 0.032 + 50.9  # Go batch-verify + merkle (see module doc)
+    print(
+        json.dumps(
+            {
+                "metric": "verify_10k_commit_plus_64k_merkle_ms",
+                "value": round(measured_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / measured_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

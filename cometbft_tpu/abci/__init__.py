@@ -1,0 +1,6 @@
+"""ABCI — the application blockchain interface (reference: abci/, 5,380 LoC).
+
+The boundary between consensus middleware and the replicated application:
+14 methods over 4 logical connections (consensus, mempool, query, snapshot)
+per abci/types/application.go:13-35 (ABCI 1.0).
+"""

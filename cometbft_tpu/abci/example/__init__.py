@@ -1,0 +1,1 @@
+"""Example ABCI applications (reference: abci/example/)."""

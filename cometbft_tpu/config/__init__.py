@@ -1,0 +1,38 @@
+"""Node configuration tree (reference: config/config.go:67-1183 + toml.go).
+
+Nested dataclasses mirror the reference's sections; `to_toml`/`from_toml`
+render/parse the node's config file; durations are seconds (float) here,
+milliseconds-suffixed strings in TOML.
+"""
+
+from cometbft_tpu.config.config import (
+    BaseConfig,
+    BlockSyncConfig,
+    Config,
+    ConsensusConfig,
+    InstrumentationConfig,
+    MempoolConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    StorageConfig,
+    TxIndexConfig,
+    default_config,
+    test_config,
+)
+
+__all__ = [
+    "BaseConfig",
+    "BlockSyncConfig",
+    "Config",
+    "ConsensusConfig",
+    "InstrumentationConfig",
+    "MempoolConfig",
+    "P2PConfig",
+    "RPCConfig",
+    "StateSyncConfig",
+    "StorageConfig",
+    "TxIndexConfig",
+    "default_config",
+    "test_config",
+]

@@ -1,0 +1,1 @@
+"""Consensus: the Tendermint BFT state machine (reference: consensus/, 7,275 LoC)."""

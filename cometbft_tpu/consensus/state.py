@@ -1,0 +1,924 @@
+"""The Tendermint BFT consensus state machine (reference: consensus/state.go).
+
+Single-writer design exactly like the reference's receiveRoutine
+(consensus/state.go:718-806): one thread owns all round state; peer
+messages, own messages, and timeouts are serialized through one queue. Own
+messages are fsynced to the WAL before processing (state.go:774), peer
+messages are buffered-written.
+
+Height/round/step transitions (state.go:988-1720): NewRound → Propose →
+Prevote → PrevoteWait → Precommit → PrecommitWait → Commit, with POL
+locking/unlocking rules and valid-block tracking.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import replace
+
+from cometbft_tpu.consensus import cstypes
+from cometbft_tpu.consensus.cstypes import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+    RoundState,
+)
+from cometbft_tpu.consensus.messages import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    ProposalMessage,
+    TimeoutInfo,
+    VoteMessage,
+)
+from cometbft_tpu.consensus.ticker import TimeoutTicker
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.types import cmttime, events as ev
+from cometbft_tpu.types.block import (
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    BlockID,
+    Commit,
+    PartSetHeader,
+)
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes, VoteError
+
+
+class _NilWAL:
+    def write(self, msg):
+        pass
+
+    def write_sync(self, msg):
+        pass
+
+    def flush_and_sync(self):
+        pass
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+class ConsensusState:
+    """consensus/state.go State."""
+
+    def __init__(
+        self,
+        config,
+        state,
+        block_exec,
+        block_store,
+        mempool,
+        evpool=None,
+        event_bus=None,
+        wal: WAL | None = None,
+        ticker: TimeoutTicker | None = None,
+        logger=None,
+        name: str = "",
+    ):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evpool = evpool
+        self.event_bus = event_bus
+        self.wal = wal or _NilWAL()
+        self.ticker = ticker or TimeoutTicker()
+        self.logger = logger
+        self.name = name
+
+        self.rs = RoundState()
+        self.state = None  # sm.State, set in update_to_state
+        self.priv_validator = None
+        self.priv_validator_pub_key = None
+        self.replay_mode = False
+
+        # Unbounded: the single consumer also produces (own proposal parts and
+        # votes enter this queue from inside the receive routine), so a
+        # bounded queue could self-deadlock on large blocks.
+        self._queue: queue.Queue = queue.Queue()
+        self._mtx = threading.RLock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._broadcast = None  # fn(msg) -> None: reactor / test harness hook
+        self._height_events = threading.Condition()
+
+        self.update_to_state(state)
+        self._reconstruct_last_commit_if_needed(state)
+
+    # -- wiring ---------------------------------------------------------------
+
+    def set_priv_validator(self, pv) -> None:
+        with self._mtx:
+            self.priv_validator = pv
+            if pv is not None:
+                self.priv_validator_pub_key = pv.get_pub_key()
+
+    def set_broadcast(self, fn) -> None:
+        """Reactor hook: called with every own message to gossip
+        (ProposalMessage / BlockPartMessage / VoteMessage)."""
+        self._broadcast = fn
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self.wal.start()
+        self.ticker.start()
+        # Hand ticker tocks into the unified queue.
+        self._tock_pump = threading.Thread(target=self._pump_tocks, daemon=True)
+        self._running = True
+        self._tock_pump.start()
+        self._thread = threading.Thread(target=self._receive_routine, daemon=True)
+        self._thread.start()
+        self._schedule_round0()
+
+    def stop(self) -> None:
+        self._running = False
+        self.ticker.stop()
+        self.wal.stop()
+
+    def _pump_tocks(self) -> None:
+        while self._running:
+            try:
+                ti = self.ticker.tock_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._queue.put(("timeout", ti, ""))
+
+    # -- message entry points -------------------------------------------------
+
+    def send_peer_message(self, msg, peer_id: str = "peer") -> None:
+        self._queue.put(("peer", msg, peer_id))
+
+    def _send_internal(self, msg) -> None:
+        self._queue.put(("internal", msg, ""))
+        if self._broadcast is not None:
+            self._broadcast(msg)
+
+    # -- the single-writer event loop (state.go:718-806) ----------------------
+
+    def _receive_routine(self) -> None:
+        while self._running:
+            try:
+                kind, payload, peer_id = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                with self._mtx:
+                    if kind == "timeout":
+                        self.wal.write(payload)
+                        self._handle_timeout(payload)
+                    elif kind == "internal":
+                        # fsync own messages before acting (state.go:774).
+                        self.wal.write_sync(payload)
+                        self._handle_msg(payload, "")
+                    else:
+                        self.wal.write(payload)
+                        self._handle_msg(payload, peer_id)
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f"consensus failure: {traceback.format_exc()}"
+                    )
+                else:
+                    traceback.print_exc()
+
+    def _handle_msg(self, msg, peer_id: str) -> None:
+        """state.go:810-880 handleMsg."""
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            added = self._add_proposal_block_part(msg, peer_id)
+            if added and self._broadcast is not None and peer_id:
+                pass  # reactor handles gossip
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+        elif isinstance(msg, HasVoteMessage):
+            pass  # peer-state bookkeeping lives in the reactor
+        else:
+            raise ValueError(f"unknown consensus message {msg!r}")
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:885-940 handleTimeout."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            if self.event_bus:
+                self.event_bus.publish_timeout_propose(rs.round_state_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ValueError(f"invalid timeout step {ti.step}")
+
+    # -- state update ---------------------------------------------------------
+
+    def update_to_state(self, state) -> None:
+        """state.go:530-640 updateToState."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState() expected state height of {rs.height} but found {state.last_block_height}"
+            )
+        last_precommits = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise RuntimeError("updateToState called with commitRound but no +2/3")
+            last_precommits = precommits
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+        validators = state.validators
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        if rs.commit_time.is_zero():
+            rs.start_time = cmttime.now().add_nanos(
+                int(self.config.timeout_commit * 1e9)
+            )
+        else:
+            rs.start_time = rs.commit_time.add_nanos(
+                int(self.config.timeout_commit * 1e9)
+            )
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        with self._height_events:
+            self._height_events.notify_all()
+
+    def _reconstruct_last_commit_if_needed(self, state) -> None:
+        """state.go reconstructLastCommit: after restart, rebuild LastCommit
+        votes from the block store's seen commit."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen_commit = (
+            self.block_store.load_seen_commit(state.last_block_height)
+            if self.block_store
+            else None
+        )
+        if seen_commit is None:
+            return
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        vote_set = VoteSet(
+            state.chain_id,
+            state.last_block_height,
+            seen_commit.round,
+            PRECOMMIT_TYPE,
+            state.last_validators,
+        )
+        for idx, cs_sig in enumerate(seen_commit.signatures):
+            if cs_sig.is_absent():
+                continue
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=seen_commit.height,
+                round=seen_commit.round,
+                block_id=cs_sig.block_id(seen_commit.block_id),
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=idx,
+                signature=cs_sig.signature,
+            )
+            vote_set.add_vote(vote)
+        self.rs.last_commit = vote_set
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _schedule_round0(self) -> None:
+        sleep = max(
+            0.0, (self.rs.start_time.unix_nanos() - cmttime.now().unix_nanos()) / 1e9
+        )
+        self.ticker.schedule_timeout(
+            TimeoutInfo(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
+        )
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:
+        self.ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
+
+    def _new_step(self) -> None:
+        if self.event_bus:
+            self.event_bus.publish_new_round_step(self.rs.round_state_event())
+
+    # -- transitions ----------------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:988-1046."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != STEP_NEW_HEIGHT
+        ):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)
+        rs.triggered_timeout_precommit = False
+        if self.event_bus:
+            proposer = validators.get_proposer()
+            self.event_bus.publish_new_round(
+                ev.EventDataNewRound(
+                    height=height,
+                    round=round_,
+                    step=cstypes.STEP_NAMES[STEP_NEW_ROUND],
+                    proposer_address=proposer.address if proposer else b"",
+                )
+            )
+        wait_for_txs = (
+            not self.config.create_empty_blocks
+            and round_ == 0
+            and not self._need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval, height, round_, STEP_NEW_ROUND
+                )
+            self.mempool.tx_available_callback = lambda: self._queue.put(
+                ("timeout", TimeoutInfo(0, height, round_, STEP_NEW_ROUND), "")
+            )
+        else:
+            self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """state.go:1049-1063: first height, or the app hash changed."""
+        if height == self.state.initial_height:
+            return True
+        last_meta = (
+            self.block_store.load_block_meta(height - 1) if self.block_store else None
+        )
+        if last_meta is None:
+            return True
+        return self.state.app_hash != last_meta.header.app_hash
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """state.go:1071-1132."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PROPOSE <= rs.step
+        ):
+            return
+        try:
+            self._schedule_timeout(
+                self.config.propose_timeout(round_), height, round_, STEP_PROPOSE
+            )
+            if self.priv_validator is None or self.priv_validator_pub_key is None:
+                return
+            address = self.priv_validator_pub_key.address()
+            if not rs.validators.has_address(address):
+                return
+            if rs.validators.get_proposer().address == address:
+                self._decide_proposal(height, round_)
+        finally:
+            rs.round = round_
+            rs.step = STEP_PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                self._enter_prevote(height, rs.round)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1135-1190 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = self._create_proposal_block()
+            if block is None:
+                return
+            block_parts = block.make_part_set()
+        self.wal.flush_and_sync()
+        prop_block_id = BlockID(block.hash(), block_parts.header())
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=prop_block_id,
+            timestamp=cmttime.now(),
+        )
+        try:
+            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            if not self.replay_mode:
+                raise
+            return
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total):
+            part = block_parts.get_part(i)
+            self._send_internal(BlockPartMessage(rs.height, rs.round, part))
+
+    def _create_proposal_block(self):
+        """state.go:1196-1233 createProposalBlock."""
+        rs = self.rs
+        if rs.height == self.state.initial_height:
+            commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            return None
+        proposer_addr = self.priv_validator_pub_key.address()
+        return self.block_exec.create_proposal_block(
+            rs.height, self.state, commit, proposer_addr
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        """state.go:1193-1208."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1250-1275."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PREVOTE <= rs.step
+        ):
+            return
+        self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1277-1335 defaultDoPrevote."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(
+                PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header()
+            )
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception:
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        if not self.block_exec.process_proposal(rs.proposal_block, self.state):
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        self._sign_add_vote(
+            PREVOTE_TYPE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PREVOTE_WAIT <= rs.step
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError(
+                f"entering prevote wait step ({height}/{round_}) without +2/3"
+            )
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote_timeout(round_), height, round_, STEP_PREVOTE_WAIT
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1373-1471."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PRECOMMIT <= rs.step
+        ):
+            return
+        try:
+            prevotes = rs.votes.prevotes(round_)
+            block_id, ok = (
+                prevotes.two_thirds_majority() if prevotes else (None, False)
+            )
+            if not ok:
+                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                return
+            if self.event_bus:
+                self.event_bus.publish_polka(rs.round_state_event())
+            pol_round, _ = rs.votes.pol_info()
+            if pol_round < round_:
+                raise RuntimeError(
+                    f"this POLRound should be {round_} but got {pol_round}"
+                )
+            if len(block_id.hash) == 0:
+                # +2/3 prevoted nil: unlock and precommit nil.
+                if rs.locked_block is not None:
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                    if self.event_bus:
+                        self.event_bus.publish_unlock(rs.round_state_event())
+                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                return
+            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                rs.locked_round = round_
+                if self.event_bus:
+                    self.event_bus.publish_relock(rs.round_state_event())
+                self._sign_add_vote(
+                    PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header
+                )
+                return
+            if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                if self.event_bus:
+                    self.event_bus.publish_lock(rs.round_state_event())
+                self._sign_add_vote(
+                    PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header
+                )
+                return
+            # Polka for a block we don't have: unlock, fetch, precommit nil.
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.part_set_header
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+        finally:
+            rs.round = round_
+            rs.step = STEP_PRECOMMIT
+            self._new_step()
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError(
+                f"entering precommit wait step ({height}/{round_}) without +2/3"
+            )
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit_timeout(round_), height, round_, STEP_PRECOMMIT_WAIT
+        )
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1527-1588."""
+        rs = self.rs
+        if rs.height != height or STEP_COMMIT <= rs.step:
+            return
+        try:
+            precommits = rs.votes.precommits(commit_round)
+            block_id, ok = precommits.two_thirds_majority()
+            if not ok:
+                raise RuntimeError("enterCommit expects +2/3 precommits")
+            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.part_set_header
+                ):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                    if self.event_bus:
+                        self.event_bus.publish_valid_block(rs.round_state_event())
+        finally:
+            rs.step = STEP_COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time = cmttime.now()
+            self._new_step()
+            self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """state.go:1590-1616."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError(f"tryFinalizeCommit() height mismatch")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id is None or len(block_id.hash) == 0:
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """state.go:1618-1720."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise RuntimeError("cannot finalize commit; no 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise RuntimeError("expected ProposalBlockParts header to be commit header")
+        if block.hash() != block_id.hash:
+            raise RuntimeError("cannot finalize commit; block hash mismatch")
+        self.block_exec.validate_block(self.state, block)
+        # Save to block store before the WAL end-height marker.
+        if self.block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+        self.wal.write_sync(EndHeightMessage(height))
+        state_copy = self.state.copy()
+        state_copy, retain_height = self.block_exec.apply_block(
+            state_copy, BlockID(block.hash(), block_parts.header()), block
+        )
+        if retain_height > 0:
+            try:
+                self.block_store.prune_blocks(retain_height)
+            except Exception:
+                pass
+        self.update_to_state(state_copy)
+        if self.priv_validator is not None:
+            self.priv_validator_pub_key = self.priv_validator.get_pub_key()
+        self._schedule_round0()
+
+    # -- proposals ------------------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """state.go defaultSetProposal (:1865-1905)."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise VoteError("error invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise VoteError("error invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
+        """state.go addProposalBlockPart (:1905-1990)."""
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if added and rs.proposal_block_parts.is_complete():
+            from cometbft_tpu.types.block import Block
+
+            rs.proposal_block = Block.decode(rs.proposal_block_parts.get_reader())
+            if self.event_bus:
+                self.event_bus.publish_complete_proposal(
+                    ev.EventDataCompleteProposal(
+                        height=rs.height,
+                        round=rs.round,
+                        step=cstypes.STEP_NAMES[rs.step],
+                        block_id=BlockID(
+                            rs.proposal_block.hash(), rs.proposal_block_parts.header()
+                        ),
+                    )
+                )
+            prevotes = rs.votes.prevotes(rs.round)
+            if prevotes is not None:
+                block_id, has_maj = prevotes.two_thirds_majority()
+                if (
+                    has_maj
+                    and block_id is not None
+                    and len(block_id.hash) > 0
+                    and rs.valid_round < rs.round
+                ):
+                    if rs.proposal_block.hash() == block_id.hash:
+                        rs.valid_round = rs.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+            if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+                self._enter_prevote(msg.height, rs.round)
+            elif rs.step == STEP_COMMIT:
+                self._try_finalize_commit(msg.height)
+        return added
+
+    # -- votes ----------------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:1974-2020 tryAddVote."""
+        from cometbft_tpu.consensus.cstypes import GotVoteFromUnwantedRoundError
+
+        try:
+            return self._add_vote(vote, peer_id)
+        except GotVoteFromUnwantedRoundError:
+            return False
+        except ErrVoteConflictingVotes as e:
+            if (
+                self.priv_validator_pub_key is not None
+                and vote.validator_address == self.priv_validator_pub_key.address()
+            ):
+                # Found conflicting vote from ourselves — bad, don't report.
+                return False
+            if self.evpool is not None:
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
+            return False
+        except VoteError:
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2022-2160 addVote."""
+        rs = self.rs
+        # Precommit for the previous height (LastCommit catchup).
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            if self.event_bus:
+                self.event_bus.publish_vote(ev.EventDataVote(vote))
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
+            return added
+        if vote.height != rs.height:
+            return False
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_bus:
+            self.event_bus.publish_vote(ev.EventDataVote(vote))
+        if vote.type == PREVOTE_TYPE:
+            prevotes = rs.votes.prevotes(vote.round)
+            # Unlock on a polka for a later round than our lock.
+            block_id, ok = prevotes.two_thirds_majority()
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and ok
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # Valid-block update.
+            if (
+                ok
+                and block_id is not None
+                and len(block_id.hash) > 0
+                and rs.valid_round < vote.round
+                and vote.round == rs.round
+            ):
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                        block_id.part_set_header
+                    ):
+                        rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and STEP_PREVOTE <= rs.step:
+                block_id2, ok2 = prevotes.two_thirds_majority()
+                if ok2 and (
+                    self._is_proposal_complete()
+                    or (block_id2 is not None and len(block_id2.hash) == 0)
+                ):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif (
+                rs.proposal is not None
+                and 0 <= rs.proposal.pol_round == vote.round
+            ):
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
+        elif vote.type == PRECOMMIT_TYPE:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if block_id is not None and len(block_id.hash) > 0:
+                    self._enter_commit(height, vote.round)
+                    if self.config.skip_timeout_commit and precommits.has_all():
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        else:
+            raise ValueError(f"unexpected vote type {vote.type}")
+        return added
+
+    def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader):
+        """state.go signAddVote."""
+        rs = self.rs
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return None
+        address = self.priv_validator_pub_key.address()
+        if not rs.validators.has_address(address):
+            return None
+        idx, _ = rs.validators.get_by_address(address)
+        vote = Vote(
+            type=msg_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(hash_, header),
+            timestamp=self._vote_time(),
+            validator_address=address,
+            validator_index=idx,
+        )
+        try:
+            vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except Exception:
+            if not self.replay_mode:
+                raise
+            return None
+        self._send_internal(VoteMessage(vote))
+        return vote
+
+    def _vote_time(self):
+        """state.go voteTime: now, but strictly after the last block time."""
+        now = cmttime.now()
+        min_time = self.state.last_block_time.add_nanos(1_000_000)
+        if now.unix_nanos() > min_time.unix_nanos():
+            return now
+        return min_time
+
+    # -- introspection --------------------------------------------------------
+
+    def get_round_state(self) -> RoundState:
+        with self._mtx:
+            return self.rs
+
+    def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
+        """Test helper: block until consensus reaches `height`."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        with self._height_events:
+            while self.rs.height < height:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    return False
+                self._height_events.wait(remaining)
+        return True

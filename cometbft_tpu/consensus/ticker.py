@@ -1,0 +1,71 @@
+"""Timeout ticker (reference: consensus/ticker.go).
+
+Schedules one pending round-step timeout at a time; scheduling a new one
+cancels the old (ticker.go:40-110). Fired timeouts land on `tock_queue`,
+drained by the consensus receive routine.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from cometbft_tpu.consensus.messages import TimeoutInfo
+
+
+class TimeoutTicker:
+    def __init__(self):
+        self.tock_queue: queue.Queue[TimeoutInfo] = queue.Queue()
+        self._timer: threading.Timer | None = None
+        self._mtx = threading.Lock()
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._running = False
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        """ticker.go ScheduleTimeout: replaces any pending timeout."""
+        with self._mtx:
+            if not self._running:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        self.tock_queue.put(ti)
+
+
+class MockTickerFactory:
+    """consensus/common_test.go newMockTickerFunc: fires immediately on
+    schedule (only for OnTimeoutPropose-style steps when fire_on_propose),
+    keeping in-process multi-node tests fast and deterministic."""
+
+    def __init__(self, fire_immediately: bool = True):
+        self.fire_immediately = fire_immediately
+
+    def __call__(self) -> "MockTicker":
+        return MockTicker(self.fire_immediately)
+
+
+class MockTicker(TimeoutTicker):
+    def __init__(self, fire_immediately: bool):
+        super().__init__()
+        self.fire_immediately = fire_immediately
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        if not self._running:
+            return
+        if self.fire_immediately:
+            self.tock_queue.put(ti)
+        else:
+            super().schedule_timeout(ti)

@@ -1,0 +1,208 @@
+"""Consensus write-ahead log (reference: consensus/wal.go).
+
+Every message is written BEFORE processing so a crashed node can replay to
+exactly where it left off (wal.go:19-30). Framing matches the reference's
+encoder (wal.go:300-340): crc32(IEEE) of payload [4B BE] || length [4B BE]
+|| payload, where payload is an encoded TimedWALMessage. An EndHeightMessage
+marks each committed height (the replay anchor, consensus/state.go:1686).
+
+Message payloads use a compact tagged encoding (type byte + proto bytes) —
+the WAL is node-local, not a wire protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.cmttime import Time
+
+MAX_MSG_SIZE_BYTES = 1048576  # 1MB (wal.go maxMsgSizeBytes)
+
+# WAL message type tags.
+MSG_END_HEIGHT = 0x01
+MSG_PROPOSAL = 0x02
+MSG_BLOCK_PART = 0x03
+MSG_VOTE = 0x04
+MSG_TIMEOUT = 0x05
+MSG_EVENT_ROUND_STATE = 0x06
+
+
+class EndHeightMessage:
+    """wal.go EndHeightMessage: height H is irrevocably committed."""
+
+    def __init__(self, height: int):
+        self.height = height
+
+    def __eq__(self, other):
+        return isinstance(other, EndHeightMessage) and other.height == self.height
+
+
+class TimedWALMessage:
+    def __init__(self, time: Time, msg):
+        self.time = time
+        self.msg = msg
+
+
+class WALWriteError(Exception):
+    pass
+
+
+class DataCorruptionError(Exception):
+    """wal.go DataCorruptionError: checksum/length failures during decode."""
+
+
+class WAL:
+    """consensus/wal.go baseWAL: file-backed, CRC-framed, fsync on demand."""
+
+    def __init__(self, path: str, codec=None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._codec = codec or _default_codec
+        self._decode = _default_decode
+        self._f = open(path, "ab")
+        self._mtx = threading.Lock()
+        self._running = True
+
+    def start(self) -> None:
+        """OnStart writes EndHeightMessage(0) into an empty WAL (wal.go:110)."""
+        if os.path.getsize(self.path) == 0:
+            self.write_sync(EndHeightMessage(0))
+
+    def write(self, msg) -> None:
+        """Buffered write (wal.go Write; group-buffered in the reference)."""
+        if not self._running:
+            return
+        data = _encode_timed(self._codec, TimedWALMessage(cmttime.now(), msg))
+        with self._mtx:
+            self._f.write(data)
+
+    def write_sync(self, msg) -> None:
+        """Write + fsync — used for own messages so the node never signs
+        without the intent being durable (wal.go WriteSync,
+        consensus/state.go:774)."""
+        if not self._running:
+            return
+        data = _encode_timed(self._codec, TimedWALMessage(cmttime.now(), msg))
+        with self._mtx:
+            self._f.write(data)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def stop(self) -> None:
+        with self._mtx:
+            if self._running:
+                self._running = False
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+
+    # -- reading / replay -----------------------------------------------------
+
+    def search_for_end_height(self, height: int):
+        """wal.go SearchForEndHeight: iterator over messages AFTER
+        EndHeightMessage(height), or None if not found."""
+        msgs = []
+        found = False
+        try:
+            for tm in self.iter_messages():
+                if found:
+                    msgs.append(tm)
+                elif (
+                    isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height
+                ):
+                    found = True
+        except DataCorruptionError:
+            if not found:
+                raise
+        if not found:
+            return None
+        return msgs
+
+    def iter_messages(self):
+        """Decode every frame; raises DataCorruptionError on a bad frame."""
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) == 0:
+                    return
+                if len(hdr) < 8:
+                    raise DataCorruptionError("truncated frame header")
+                crc, length = struct.unpack(">II", hdr)
+                if length > MAX_MSG_SIZE_BYTES:
+                    raise DataCorruptionError(
+                        f"length {length} exceeds maximum possible value"
+                    )
+                payload = f.read(length)
+                if len(payload) < length:
+                    raise DataCorruptionError("truncated frame payload")
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    raise DataCorruptionError("checksums do not match")
+                yield _decode_timed(self._decode, payload)
+
+
+def repair_wal(src_path: str, dst_path: str) -> int:
+    """Copy intact frames, drop everything from the first corrupt frame on
+    (consensus/state.go:320-360 corrupted-WAL repair). Returns frames kept."""
+    kept = 0
+    with open(src_path, "rb") as src, open(dst_path, "wb") as dst:
+        while True:
+            hdr = src.read(8)
+            if len(hdr) < 8:
+                break
+            crc, length = struct.unpack(">II", hdr)
+            if length > MAX_MSG_SIZE_BYTES:
+                break
+            payload = src.read(length)
+            if len(payload) < length or zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break
+            dst.write(hdr)
+            dst.write(payload)
+            kept += 1
+    return kept
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def _encode_timed(codec, tm: TimedWALMessage) -> bytes:
+    body = struct.pack(">qi", tm.time.seconds, tm.time.nanos) + codec(tm.msg)
+    if len(body) > MAX_MSG_SIZE_BYTES:
+        raise WALWriteError(f"msg is too big: {len(body)} bytes")
+    return struct.pack(">II", zlib.crc32(body) & 0xFFFFFFFF, len(body)) + body
+
+
+def _decode_timed(decode, payload: bytes) -> TimedWALMessage:
+    secs, nanos = struct.unpack(">qi", payload[:12])
+    return TimedWALMessage(Time(secs, nanos), decode(payload[12:]))
+
+
+def _default_codec(msg) -> bytes:
+    """Tag + payload; consensus messages provide .encode()."""
+    from cometbft_tpu.consensus import messages as cmsg
+
+    if isinstance(msg, EndHeightMessage):
+        from cometbft_tpu.wire import proto as wire
+
+        return bytes([MSG_END_HEIGHT]) + wire.encode_varint_signed(msg.height)
+    return cmsg.encode_wal_message(msg)
+
+
+def _default_decode(data: bytes):
+    from cometbft_tpu.consensus import messages as cmsg
+
+    tag = data[0]
+    if tag == MSG_END_HEIGHT:
+        from cometbft_tpu.wire import proto as wire
+
+        height, _ = wire.decode_varint_signed(data[1:], 0)
+        return EndHeightMessage(height)
+    return cmsg.decode_wal_message(data)

@@ -1,0 +1,5 @@
+"""Mempool (reference: mempool/, 1,607 LoC)."""
+
+from cometbft_tpu.mempool.clist_mempool import CListMempool, TxCache
+
+__all__ = ["CListMempool", "TxCache"]

@@ -89,18 +89,33 @@ def _carry(x: jnp.ndarray) -> jnp.ndarray:
     return r + jnp.roll(c, 1, axis=0) * jnp.asarray(_WRAP)
 
 
+# One-hot accumulation matrix: entry [k, j*17+i] = 1 where the low half of
+# product x_i*y_j lands in column i+j, and [k, 289 + j*17+i] = 1 where the
+# high half lands in column i+j+1. One f32 matmul replaces 34 pad+adds —
+# a single MXU-friendly op with exact integer arithmetic (all values < 2^21
+# are exactly representable in float32).
+_ACC = np.zeros((2 * LIMBS, 2 * LIMBS * LIMBS), np.float32)
+for _j in range(LIMBS):
+    for _i in range(LIMBS):
+        _ACC[_i + _j, _j * LIMBS + _i] = 1.0
+        _ACC[_i + _j + 1, LIMBS * LIMBS + _j * LIMBS + _i] = 1.0
+
+
 def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """z = x*y mod p under the loose invariant. Schoolbook [17,17,N] product,
-    15-bit split, float32 column accumulation (exact: columns < 2^21),
-    19-fold, two parallel carry passes."""
+    15-bit split, one-hot f32 matmul column accumulation (exact: columns
+    < 2^21), 19-fold, two parallel carry passes."""
+    n = x.shape[1]
     p = x[None, :, :] * y[:, None, :]  # [j, i, N] int32, < 2^30.1
-    lo = (p & MASK).astype(jnp.float32)
-    hi = (p >> LIMB_BITS).astype(jnp.float32)
-    rows = []
-    for j in range(LIMBS):
-        rows.append(jnp.pad(lo[j], ((j, LIMBS - j), (0, 0))))       # col i+j
-        rows.append(jnp.pad(hi[j], ((j + 1, LIMBS - 1 - j), (0, 0))))  # col i+j+1
-    cols = jnp.sum(jnp.stack(rows), axis=0).astype(jnp.int32)  # [34, N]
+    lo = (p & MASK).astype(jnp.float32).reshape(LIMBS * LIMBS, n)
+    hi = (p >> LIMB_BITS).astype(jnp.float32).reshape(LIMBS * LIMBS, n)
+    flat = jnp.concatenate([lo, hi], axis=0)  # [578, N]
+    cols = lax.dot_general(
+        jnp.asarray(_ACC),
+        flat,
+        (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+    ).astype(jnp.int32)  # [34, N]
     folded = cols[:LIMBS] + 19 * cols[LIMBS:]
     return _carry(_carry(folded))
 

@@ -1,0 +1,126 @@
+"""Multi-chip sharding of the verification data path (SURVEY.md §2.13, §5.7).
+
+The "sequence parallelism" analog of this framework: a 10k+ signature commit
+batch is sharded across chips on a 1-D `sig` mesh (pure data parallel — the
+Shamir ladder is elementwise over lanes, zero communication), and Merkle
+trees are sharded by subtree: each chip reduces its leaf shard level-by-level
+locally, subtree roots ride one all_gather over ICI, and the (tiny) top of
+the tree is finished replicated. The overall-valid bit is a psum reduction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cometbft_tpu.ops import ed25519_kernel as ek
+from cometbft_tpu.ops import merkle_kernel as mk
+from cometbft_tpu.ops import sha256_kernel as sha
+
+
+def make_mesh(devices=None, axis: str = "sig") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
+    """jit-compiled batch verify with operands sharded over the batch dim
+    (limb arrays are [17, N]: shard N). Returns ok bool[N] (sharded)."""
+    shard_n = NamedSharding(mesh, P(None, axis))
+    in_shardings = (shard_n, NamedSharding(mesh, P(axis)),
+                    shard_n, NamedSharding(mesh, P(axis)),
+                    shard_n, shard_n)
+    return jax.jit(
+        ek.verify_core,
+        in_shardings=in_shardings,
+        out_shardings=NamedSharding(mesh, P(axis)),
+    )
+
+
+def _local_tree_root(leaves):
+    """Reduce uint32[8, m] leaf digests (m a power of two) to one root [8, 1]
+    with level-synchronous pairing."""
+    cur = leaves
+    while cur.shape[1] > 1:
+        cur = mk._inner_core(cur[:, 0::2], cur[:, 1::2])
+    return cur
+
+
+def sharded_merkle_fn(mesh: Mesh, axis: str = "sig"):
+    """shard_map'd subtree-parallel Merkle root: leaf digests uint32[8, n]
+    (n = pow2, divisible by mesh size) -> replicated root uint32[8, 1]."""
+
+    def local(leaf_shard):
+        root = _local_tree_root(leaf_shard)  # [8, 1] per device
+        roots = jax.lax.all_gather(root[:, 0], axis, axis=1)  # [8, ndev]
+        return _local_tree_root(roots)  # replicated top reduction
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=P(None, None),
+        )
+    )
+
+
+def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
+    """The full 'training step' analog: one jitted program that verifies a
+    sharded signature batch AND reduces a sharded Merkle leaf forest, with a
+    psum for the all-valid bit."""
+
+    def step(y_a, sign_a, y_r, sign_r, s_bits, k_bits, leaf_digests):
+        ok = ek.verify_core(y_a, sign_a, y_r, sign_r, s_bits, k_bits)
+
+        def reduce_shard(ok_shard, leaf_shard):
+            local_ok = jnp.all(ok_shard).astype(jnp.int32)
+            total_ok = jax.lax.psum(local_ok, axis)  # ICI all-reduce
+            root = _local_tree_root(leaf_shard)
+            roots = jax.lax.all_gather(root[:, 0], axis, axis=1)
+            top = _local_tree_root(roots)
+            return total_ok[None], top
+
+        total_ok, root = jax.shard_map(
+            reduce_shard,
+            mesh=mesh,
+            in_specs=(P(axis), P(None, axis)),
+            out_specs=(P(axis), P(None, None)),
+        )(ok, leaf_digests)
+        n_dev = mesh.devices.size
+        all_valid = jnp.sum(total_ok) == n_dev * n_dev  # psum'd per shard
+        return ok, all_valid, root
+
+    shard_n = NamedSharding(mesh, P(None, axis))
+    shard_1 = NamedSharding(mesh, P(axis))
+    return jax.jit(
+        step,
+        in_shardings=(shard_n, shard_1, shard_n, shard_1, shard_n, shard_n, shard_n),
+    )
+
+
+def make_example_batch(n: int):
+    """Deterministic signed batch packed for verify_core (host crypto is
+    C-speed; used by bench + graft entry)."""
+    from cometbft_tpu.crypto import ed25519 as host_ed
+
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = host_ed.gen_priv_key_from_secret(b"bench-%d" % i)
+        pub = priv.pub_key().bytes()
+        msg = b"commit-vote-%d" % i
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    operands, host_ok = ek.pack_batch(pubs, msgs, sigs)
+    assert all(host_ok[: len(pubs)])
+    return tuple(jnp.asarray(o) for o in operands)
+
+
+def make_example_leaves(n: int):
+    """Leaf digests uint32[8, n] for n power-of-two txs."""
+    txs = [b"tx-%d" % i for i in range(n)]
+    return jnp.asarray(mk.hash_leaves_device(txs))

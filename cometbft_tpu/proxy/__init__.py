@@ -1,0 +1,36 @@
+"""proxy.AppConns: the four named ABCI connections multiplexed over one
+client (reference: proxy/app_conn.go:17-56, proxy/multi_app_conn.go).
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.abci.client import Client, ClientCreator, LocalClientCreator
+
+
+class AppConns:
+    """proxy/multi_app_conn.go: consensus/mempool/query/snapshot connections."""
+
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: Client | None = None
+        self.mempool: Client | None = None
+        self.query: Client | None = None
+        self.snapshot: Client | None = None
+
+    def start(self) -> None:
+        self.query = self._creator.new_abci_client()
+        self.snapshot = self._creator.new_abci_client()
+        self.mempool = self._creator.new_abci_client()
+        self.consensus = self._creator.new_abci_client()
+
+    def stop(self) -> None:
+        self.consensus = self.mempool = self.query = self.snapshot = None
+
+
+def new_app_conns(creator: ClientCreator) -> AppConns:
+    conns = AppConns(creator)
+    return conns
+
+
+def local_client_creator(app) -> LocalClientCreator:
+    return LocalClientCreator(app)

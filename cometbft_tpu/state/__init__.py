@@ -1,0 +1,7 @@
+"""State execution layer (reference: state/)."""
+
+from cometbft_tpu.state.state import State, make_genesis_state, median_time
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.state.execution import BlockExecutor
+
+__all__ = ["State", "StateStore", "BlockExecutor", "make_genesis_state", "median_time"]

@@ -193,6 +193,9 @@ class EventBus:
     def publish_lock(self, data: EventDataRoundState) -> None:
         self._publish(EVENT_LOCK, data)
 
+    def publish_unlock(self, data: EventDataRoundState) -> None:
+        self._publish(EVENT_UNLOCK, data)
+
     def publish_valid_block(self, data: EventDataRoundState) -> None:
         self._publish(EVENT_VALID_BLOCK, data)
 
