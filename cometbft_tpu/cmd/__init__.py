@@ -1,0 +1,1 @@
+"""CLI (reference: cmd/cometbft/, 2,446 LoC)."""

@@ -1,0 +1,331 @@
+"""`python -m cometbft_tpu.cmd` — the node CLI
+(reference: cmd/cometbft/main.go:16-36 command registry).
+
+Subcommands: init, start, devnet, testnet, gen-validator, gen-node-key,
+show-validator, show-node-id, rollback, reset-state, unsafe-reset-all,
+version, inspect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import sys
+import time
+
+
+def _default_home() -> str:
+    return os.environ.get("CMTHOME", os.path.expanduser("~/.cometbft_tpu"))
+
+
+def cmd_version(args) -> int:
+    from cometbft_tpu.version import VERSION
+
+    print(VERSION)
+    return 0
+
+
+def cmd_init(args) -> int:
+    """cmd/cometbft/commands/init.go: genesis + validator key + node key."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = args.home
+    cfg = default_config().set_root(home)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.load_or_generate(
+        cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+    )
+    genesis_path = cfg.base.genesis_path()
+    if not os.path.exists(genesis_path):
+        pub = pv.get_pub_key()
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=cmttime.now(),
+            validators=[GenesisValidator(pub.address(), pub, 10, "")],
+        )
+        doc.validate_and_complete()
+        doc.save_as(genesis_path)
+        print(f"Generated genesis file: {genesis_path}")
+    _write_node_key(cfg.base.node_key_path())
+    print(f"Initialized node in {home}")
+    return 0
+
+
+def _write_node_key(path: str) -> None:
+    if os.path.exists(path):
+        return
+    from cometbft_tpu.crypto import ed25519
+
+    key = ed25519.gen_priv_key()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "priv_key": {
+                    "type": "tendermint/PrivKeyEd25519",
+                    "value": base64.b64encode(key.bytes()).decode(),
+                }
+            },
+            f,
+        )
+
+
+def cmd_start(args) -> int:
+    """cmd/cometbft/commands/run_node.go: run one node until interrupted."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import default_new_node
+
+    cfg = default_config().set_root(args.home)
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    node = default_new_node(cfg)
+    node.start()
+    print(f"Node started; RPC on {cfg.rpc.laddr}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def cmd_devnet(args) -> int:
+    """In-process multi-validator devnet — the minimum end-to-end slice
+    (SURVEY.md §7): N validators over an in-memory switch, RPC on node 0."""
+    # Default to host-tier verification: lazily compiling the TPU kernels in
+    # the middle of a live consensus round would stall block production.
+    # Opt into the device tier with --backend tpu (pre-warms before starting).
+    os.environ.setdefault("CMTPU_BACKEND", args.backend)
+    if os.environ["CMTPU_BACKEND"] == "tpu":
+        from cometbft_tpu.ops import ed25519_kernel as _ek
+
+        print("pre-warming TPU verify kernel...")
+        _ek.batch_verify([b"\x00" * 32] * 8, [b""] * 8, [b"\x00" * 64] * 8)
+
+    from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.client import LocalClientCreator
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.crypto import ed25519
+
+    n = args.validators
+    pvs = [FilePV(ed25519.gen_priv_key()) for _ in range(n)]
+    doc = GenesisDoc(
+        chain_id="devnet",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    doc.validate_and_complete()
+    nodes = []
+    for i, pv in enumerate(pvs):
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.consensus.timeout_commit = args.block_interval
+        cfg.consensus.skip_timeout_commit = False
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.rpc_port}" if i == 0 else ""
+        node = Node(cfg, doc, pv, LocalClientCreator(KVStoreApplication()))
+        nodes.append(node)
+
+    def make_broadcast(src):
+        def bcast(msg):
+            for j, other in enumerate(nodes):
+                if j != src:
+                    other.consensus_state.send_peer_message(msg, peer_id=f"node{src}")
+        return bcast
+
+    for i, node in enumerate(nodes):
+        node.consensus_state.set_broadcast(make_broadcast(i))
+    for node in nodes:
+        node.start()
+    print(f"devnet: {n} validators, RPC http://127.0.0.1:{args.rpc_port}")
+    cs0 = nodes[0].consensus_state
+    target = args.blocks
+    t0 = time.time()
+    try:
+        last = 0
+        while target <= 0 or cs0.rs.height <= target:
+            time.sleep(0.2)
+            if cs0.rs.height != last:
+                last = cs0.rs.height
+                print(f"height={last - 1} committed  ({(last - 1) / max(time.time() - t0, 1e-9):.2f} blocks/s)")
+            if target > 0 and cs0.rs.height > target:
+                break
+    except KeyboardInterrupt:
+        pass
+    for node in nodes:
+        node.stop()
+    print(f"devnet done at height {cs0.rs.height - 1}")
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.privval import FilePV
+
+    cfg = default_config().set_root(args.home)
+    pv = FilePV.load(
+        cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+    )
+    pub = pv.get_pub_key()
+    print(
+        json.dumps(
+            {"type": "tendermint/PubKeyEd25519", "value": base64.b64encode(pub.bytes()).decode()}
+        )
+    )
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from cometbft_tpu.config import default_config
+
+    cfg = default_config().set_root(args.home)
+    with open(cfg.base.node_key_path()) as f:
+        d = json.load(f)
+    from cometbft_tpu.crypto import ed25519
+
+    key = ed25519.PrivKey(base64.b64decode(d["priv_key"]["value"]))
+    print(key.pub_key().address().hex())
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from cometbft_tpu.crypto import ed25519
+
+    key = ed25519.gen_priv_key()
+    pub = key.pub_key()
+    print(
+        json.dumps(
+            {
+                "address": pub.address().hex().upper(),
+                "pub_key": {"type": "tendermint/PubKeyEd25519", "value": base64.b64encode(pub.bytes()).decode()},
+                "priv_key": {"type": "tendermint/PrivKeyEd25519", "value": base64.b64encode(key.bytes()).decode()},
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """cmd rollback (state/rollback.go): undo one height of state."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.libs.db import new_db
+    from cometbft_tpu.state.rollback import rollback_state
+    from cometbft_tpu.state.store import StateStore
+    from cometbft_tpu.store import BlockStore
+
+    cfg = default_config().set_root(args.home)
+    state_store = StateStore(new_db("state", cfg.base.db_backend, cfg.base.db_path()))
+    block_store = BlockStore(new_db("blockstore", cfg.base.db_backend, cfg.base.db_path()))
+    height, app_hash = rollback_state(state_store, block_store)
+    print(f"Rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_reset_state(args) -> int:
+    import shutil
+
+    data = os.path.join(args.home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    print(f"Removed all blockchain data in {data}")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """cmd/cometbft/commands/testnet.go: generate N validator homes with a
+    shared genesis."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.validators
+    pvs = []
+    for i in range(n):
+        home = os.path.join(args.output_dir, f"node{i}")
+        cfg = default_config().set_root(home)
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pv = FilePV.load_or_generate(
+            cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+        )
+        _write_node_key(cfg.base.node_key_path())
+        pvs.append(pv)
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "testnet",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 1, f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    doc.validate_and_complete()
+    for i in range(n):
+        doc.save_as(os.path.join(args.output_dir, f"node{i}", "config", "genesis.json"))
+    print(f"Successfully initialized {n} node directories in {args.output_dir}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cometbft_tpu")
+    p.add_argument("--home", default=_default_home())
+    sub = p.add_subparsers(dest="command")
+
+    sub.add_parser("version")
+    sp = sub.add_parser("init")
+    sp.add_argument("--chain-id", default="")
+    sp = sub.add_parser("start")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp = sub.add_parser("devnet")
+    sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--blocks", type=int, default=10)
+    sp.add_argument("--rpc-port", type=int, default=26657)
+    sp.add_argument("--block-interval", type=float, default=1.0)
+    sp.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "auto"])
+    sub.add_parser("show-validator")
+    sub.add_parser("show-node-id")
+    sub.add_parser("gen-validator")
+    sub.add_parser("rollback")
+    sub.add_parser("reset-state")
+    sub.add_parser("unsafe-reset-all")
+    sp = sub.add_parser("testnet")
+    sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--output-dir", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+
+    args = p.parse_args(argv)
+    handlers = {
+        "version": cmd_version,
+        "init": cmd_init,
+        "start": cmd_start,
+        "devnet": cmd_devnet,
+        "show-validator": cmd_show_validator,
+        "show-node-id": cmd_show_node_id,
+        "gen-validator": cmd_gen_validator,
+        "rollback": cmd_rollback,
+        "reset-state": cmd_reset_state,
+        "unsafe-reset-all": cmd_reset_state,
+        "testnet": cmd_testnet,
+    }
+    if args.command is None:
+        p.print_help()
+        return 1
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,12 +63,13 @@ class HeightVoteSet:
         }
 
     def set_round(self, round_: int) -> None:
-        """Create vote sets up to round+1 (height_vote_set.go SetRound)."""
+        """Create vote sets up to and including round_ (the caller passes
+        current+1 — height_vote_set.go SetRound)."""
         with self._mtx:
             new_round = self.round - 1 if self.round > 0 else 0
             if self.round != 0 and round_ < new_round:
                 raise ValueError("SetRound() must increment hvs.round")
-            for r in range(new_round, round_ + 2):
+            for r in range(new_round, round_ + 1):
                 if r not in self._round_vote_sets:
                     self._add_round(r)
             self.round = round_

@@ -907,8 +907,13 @@ class ConsensusState:
     # -- introspection --------------------------------------------------------
 
     def get_round_state(self) -> RoundState:
+        """Shallow snapshot under the mutex — readers (RPC) must not see the
+        receive routine mutating fields mid-transition (state.go GetRoundState
+        returns a copy)."""
+        import copy as _copy
+
         with self._mtx:
-            return self.rs
+            return _copy.copy(self.rs)
 
     def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
         """Test helper: block until consensus reaches `height`."""

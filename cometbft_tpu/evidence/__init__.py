@@ -1,0 +1,6 @@
+"""Evidence pool (reference: evidence/, 1,261 LoC)."""
+
+from cometbft_tpu.evidence.pool import EvidencePool
+from cometbft_tpu.evidence.verify import verify_evidence
+
+__all__ = ["EvidencePool", "verify_evidence"]

@@ -187,6 +187,14 @@ class CListMempool:
                 post_ok = False
         if res.code == abci.CODE_TYPE_OK and post_ok:
             with self._mtx:
+                # Re-check capacity at insertion time: other txs may have been
+                # admitted since the pre-flight check (clist_mempool.go:386
+                # resCbFirstTime re-runs isFull).
+                if self.size() >= self.config.size or (
+                    self._txs_bytes + len(tx) > self.config.max_txs_bytes
+                ):
+                    self.cache.remove(tx)
+                    return
                 k = tx_key(tx)
                 if k not in self._txs:
                     self._txs[k] = MempoolTx(

@@ -1,0 +1,5 @@
+"""Node assembly (reference: node/node.go NewNode + OnStart)."""
+
+from cometbft_tpu.node.node import Node, default_new_node
+
+__all__ = ["Node", "default_new_node"]

@@ -1,0 +1,273 @@
+"""Node: dependency assembly + lifecycle (reference: node/node.go:137 NewNode,
+:371 OnStart, node/setup.go:64 DefaultNewNode).
+
+Assembly order mirrors the reference: DBs → state → ABCI conns → handshake
+replay → event bus + indexers → mempool/evidence/executor → consensus → RPC.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cometbft_tpu.abci import types as abci_types
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.libs.db import new_db
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.proxy import AppConns
+from cometbft_tpu.rpc.core import Environment, routes
+from cometbft_tpu.rpc.jsonrpc.server import JSONRPCServer
+from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from cometbft_tpu.state.txindex import (
+    IndexerService,
+    KVBlockIndexer,
+    KVTxIndexer,
+    NullTxIndexer,
+)
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.events import EventBus
+from cometbft_tpu.types.genesis import GenesisDoc
+
+
+class Node:
+    """node/node.go Node."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis_doc: GenesisDoc,
+        priv_validator,
+        client_creator,
+        logger=None,
+    ):
+        self.config = config
+        self.genesis_doc = genesis_doc
+        self.priv_validator = priv_validator
+        self.logger = logger
+
+        # Storage (node/node.go:147 initDBs).
+        db_dir = config.base.db_path()
+        self.block_store = BlockStore(new_db("blockstore", config.base.db_backend, db_dir))
+        self.state_store = StateStore(new_db("state", config.base.db_backend, db_dir))
+
+        # State from DB or genesis (node/node.go:156).
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(genesis_doc)
+            self.state_store.save(state)
+
+        # ABCI connections (node/node.go:164).
+        self.proxy_app = AppConns(client_creator)
+        self.proxy_app.start()
+
+        # Handshake: replay stored blocks into the app (node/node.go:210,
+        # consensus/replay.go Handshaker) — see handshake() below.
+        state = self._handshake(state)
+
+        # Event bus + indexers (node/node.go:173-182).
+        self.event_bus = EventBus()
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(new_db("tx_index", config.base.db_backend, db_dir))
+            self.block_indexer = KVBlockIndexer(
+                new_db("block_index", config.base.db_backend, db_dir)
+            )
+        else:
+            self.tx_indexer = NullTxIndexer()
+            self.block_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus
+        )
+
+        # Mempool + evidence + executor (node/node.go:230-248).
+        self.mempool = CListMempool(config.mempool, self.proxy_app.mempool)
+        self.evidence_pool = EvidencePool(
+            new_db("evidence", config.base.db_backend, db_dir),
+            self.state_store,
+            self.block_store,
+            logger,
+        )
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            self.mempool,
+            self.evidence_pool,
+            self.block_store,
+            self.event_bus,
+            logger,
+        )
+
+        # Consensus (node/node.go:256).
+        wal = WAL(config.consensus.wal_path()) if config.base.root_dir else None
+        self.consensus_state = ConsensusState(
+            config.consensus,
+            state,
+            self.block_executor,
+            self.block_store,
+            self.mempool,
+            self.evidence_pool,
+            self.event_bus,
+            wal=wal,
+        )
+        if priv_validator is not None:
+            self.consensus_state.set_priv_validator(priv_validator)
+
+        # RPC (node/node.go:392 startRPC).
+        self.rpc_server = None
+        self._rpc_env = None
+
+    # -- handshake / replay ---------------------------------------------------
+
+    def _handshake(self, state):
+        """consensus/replay.go:241 Handshake: query app Info, replay stored
+        blocks ahead of the app's last height."""
+        info = self.proxy_app.query.info(abci_types.RequestInfo())
+        app_height = info.last_block_height
+        store_height = self.block_store.height()
+        if app_height == 0 and state.last_block_height == 0:
+            # InitChain (replay.go:280-330).
+            validators = [
+                abci_types.ValidatorUpdate(pub_key=v.pub_key, power=v.power)
+                for v in self.genesis_doc.validators
+            ]
+            res = self.proxy_app.consensus.init_chain(
+                abci_types.RequestInitChain(
+                    time_seconds=self.genesis_doc.genesis_time.seconds,
+                    chain_id=self.genesis_doc.chain_id,
+                    consensus_params=self.genesis_doc.consensus_params,
+                    validators=validators,
+                    app_state_bytes=b"",
+                    initial_height=self.genesis_doc.initial_height,
+                )
+            )
+            if res.app_hash:
+                state.app_hash = res.app_hash
+            if res.validators:
+                from cometbft_tpu.types.validator import Validator
+                from cometbft_tpu.types.validator_set import ValidatorSet
+
+                vals = [
+                    Validator.new(vu.pub_key, vu.power) for vu in res.validators
+                ]
+                state.validators = ValidatorSet(vals)
+                state.next_validators = state.validators.copy_increment_proposer_priority(1)
+            self.state_store.save(state)
+            return state
+        # Replay blocks the app hasn't seen (replay.go:284 ReplayBlocks),
+        # using the validator set stored for each historical height so
+        # BeginBlock's last_commit_info matches what the app saw live.
+        if app_height > state.last_block_height:
+            raise RuntimeError(
+                f"app block height ({app_height}) is higher than core ({state.last_block_height})"
+            )
+        if app_height < state.last_block_height:
+            from cometbft_tpu.state.execution import build_last_commit_info
+
+            for h in range(app_height + 1, store_height + 1):
+                block = self.block_store.load_block(h)
+                if block is None:
+                    break
+                try:
+                    vals_prev = self.state_store.load_validators(h - 1) if h > 1 else None
+                except Exception:
+                    vals_prev = None
+                commit_info = build_last_commit_info(block.last_commit, vals_prev)
+                self.proxy_app.consensus.begin_block(
+                    abci_types.RequestBeginBlock(
+                        hash=block.hash() or b"",
+                        header=block.header,
+                        last_commit_info=commit_info,
+                    )
+                )
+                for tx in block.data.txs:
+                    self.proxy_app.consensus.deliver_tx(
+                        abci_types.RequestDeliverTx(tx=tx)
+                    )
+                self.proxy_app.consensus.end_block(
+                    abci_types.RequestEndBlock(height=h)
+                )
+                self.proxy_app.consensus.commit()
+        return state
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        """node/node.go:371 OnStart."""
+        self.event_bus.start()
+        self.indexer_service.start()
+        self.consensus_state.start()
+        rpc_laddr = self.config.rpc.laddr
+        if rpc_laddr:
+            host, port = _parse_laddr(rpc_laddr)
+            pub = None
+            if self.priv_validator is not None:
+                pub = self.priv_validator.get_pub_key()
+            env = Environment(
+                config=self.config,
+                state_store=self.state_store,
+                block_store=self.block_store,
+                consensus_state=self.consensus_state,
+                mempool=self.mempool,
+                evidence_pool=self.evidence_pool,
+                event_bus=self.event_bus,
+                genesis_doc=self.genesis_doc,
+                priv_validator_pub_key=pub,
+                node_info={"moniker": self.config.base.moniker, "network": self.genesis_doc.chain_id},
+                tx_indexer=self.tx_indexer,
+                block_indexer=self.block_indexer,
+                proxy_app_query=self.proxy_app.query,
+            )
+            self._rpc_env = env
+            self.rpc_server = JSONRPCServer(routes(env), host, port)
+            self.rpc_server.start()
+
+    def stop(self) -> None:
+        self.consensus_state.stop()
+        self.indexer_service.stop()
+        self.event_bus.stop()
+        if self.rpc_server:
+            self.rpc_server.stop()
+
+    @property
+    def rpc_port(self) -> int:
+        return self.rpc_server.port if self.rpc_server else 0
+
+
+class _NopMempool:
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def flush_app_conn(self):
+        pass
+
+    def update(self, *a, **k):
+        pass
+
+    def reap_max_bytes_max_gas(self, *a):
+        return []
+
+
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def default_new_node(config: Config, logger=None, app=None) -> Node:
+    """node/setup.go:64 DefaultNewNode: files from config, kvstore app when
+    none supplied (proxy_app == "kvstore")."""
+    genesis = GenesisDoc.from_file(config.base.genesis_path())
+    pv = FilePV.load_or_generate(
+        config.base.priv_validator_key_path(),
+        config.base.priv_validator_state_path(),
+    )
+    if app is None:
+        app = KVStoreApplication()
+    return Node(config, genesis, pv, LocalClientCreator(app), logger)

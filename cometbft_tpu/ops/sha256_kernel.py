@@ -44,23 +44,41 @@ def iv_state(n):
 
 
 def compress(state, words):
-    """One SHA-256 compression. state uint32[8, N], words uint32[16, N]."""
-    w = [words[i] for i in range(16)]
-    a, b, c, d, e, f, g, h = (state[i] for i in range(8))
-    for t in range(64):
-        if t >= 16:
-            s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
-            s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
-            w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    """One SHA-256 compression. state uint32[8, N], words uint32[16, N].
+
+    Rolled into two fori_loops (message schedule, then rounds) so the graph
+    stays ~100 ops regardless of the 64-round depth — unrolling produced a
+    1k-op chain that XLA compiled orders of magnitude slower."""
+    from jax import lax
+
+    n = words.shape[1]
+    # Tie the state carry to the (possibly device-varying) words so the loop
+    # carries have uniform varying-axes under shard_map (no-op arithmetic).
+    state = state + (words[:1] & jnp.uint32(0))
+    w = jnp.concatenate([words, jnp.zeros((48, n), jnp.uint32)], axis=0)
+
+    def sched(t, w):
+        x15 = w[t - 15]
+        x2 = w[t - 2]
+        s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> 3)
+        s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> 10)
+        return w.at[t].set(w[t - 16] + s0 + w[t - 7] + s1)
+
+    w = lax.fori_loop(16, 64, sched, w)
+    k = jnp.asarray(_K, jnp.uint32)
+
+    def rnd(t, carry):
+        a, b, c, d, e, f, g, h = carry
         big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + big_s1 + ch + jnp.uint32(_K[t]) + w[t]
+        t1 = h + big_s1 + ch + k[t] + w[t]
         big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = big_s0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h])
-    return state + out
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    out = lax.fori_loop(0, 64, rnd, tuple(state[i] for i in range(8)))
+    return state + jnp.stack(out)
 
 
 def pack_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:

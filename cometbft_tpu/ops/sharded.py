@@ -56,16 +56,19 @@ def sharded_merkle_fn(mesh: Mesh, axis: str = "sig"):
     def local(leaf_shard):
         root = _local_tree_root(leaf_shard)  # [8, 1] per device
         roots = jax.lax.all_gather(root[:, 0], axis, axis=1)  # [8, ndev]
-        return _local_tree_root(roots)  # replicated top reduction
+        # Every device computes the identical top reduction; emit one column
+        # per device (JAX's varying-axis checker can't see the replication).
+        return _local_tree_root(roots)
 
-    return jax.jit(
+    fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
             in_specs=P(None, axis),
-            out_specs=P(None, None),
+            out_specs=P(None, axis),
         )
     )
+    return lambda leaves: fn(leaves)[:, :1]
 
 
 def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
@@ -81,18 +84,18 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
             total_ok = jax.lax.psum(local_ok, axis)  # ICI all-reduce
             root = _local_tree_root(leaf_shard)
             roots = jax.lax.all_gather(root[:, 0], axis, axis=1)
-            top = _local_tree_root(roots)
+            top = _local_tree_root(roots)  # identical on every device
             return total_ok[None], top
 
-        total_ok, root = jax.shard_map(
+        total_ok, root_cols = jax.shard_map(
             reduce_shard,
             mesh=mesh,
             in_specs=(P(axis), P(None, axis)),
-            out_specs=(P(axis), P(None, None)),
+            out_specs=(P(axis), P(None, axis)),
         )(ok, leaf_digests)
         n_dev = mesh.devices.size
         all_valid = jnp.sum(total_ok) == n_dev * n_dev  # psum'd per shard
-        return ok, all_valid, root
+        return ok, all_valid, root_cols[:, :1]
 
     shard_n = NamedSharding(mesh, P(None, axis))
     shard_1 = NamedSharding(mesh, P(axis))

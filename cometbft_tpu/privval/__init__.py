@@ -1,0 +1,5 @@
+"""Validator signing (reference: privval/, 1,770 LoC)."""
+
+from cometbft_tpu.privval.file import FilePV, LastSignState
+
+__all__ = ["FilePV", "LastSignState"]

@@ -1,0 +1,1 @@
+"""RPC / API layer (reference: rpc/, 8,640 LoC)."""

@@ -168,20 +168,7 @@ class BlockExecutor:
         commit's signatures against the validator set at that height."""
         if commit is None or state.last_block_height == 0:
             return abci.CommitInfo()
-        vals = state.last_validators
-        votes = []
-        for i, cs in enumerate(commit.signatures):
-            if i >= vals.size():
-                break
-            val = vals.validators[i]
-            votes.append(
-                abci.VoteInfo(
-                    validator_address=val.address,
-                    validator_power=val.voting_power,
-                    signed_last_block=not cs.is_absent(),
-                )
-            )
-        return abci.CommitInfo(round=commit.round, votes=votes)
+        return build_last_commit_info(commit, state.last_validators)
 
     def _fire_events(self, block, block_id, abci_responses, validator_updates) -> None:
         """state/execution.go fireEvents: NewBlock, NewBlockHeader, per-Tx,
@@ -219,6 +206,27 @@ class BlockExecutor:
             self.event_bus.publish_validator_set_updates(
                 ev.EventDataValidatorSetUpdates(validator_updates=validator_updates)
             )
+
+
+def build_last_commit_info(commit: Commit | None, vals) -> abci.CommitInfo:
+    """Positional commit-sig ↔ validator matching for BeginBlock
+    (state/execution.go getBeginBlockValidatorInfo); `vals` must be the
+    validator set of the commit's height (historical on replay)."""
+    if commit is None or vals is None:
+        return abci.CommitInfo()
+    votes = []
+    for i, cs in enumerate(commit.signatures):
+        if i >= vals.size():
+            break
+        val = vals.validators[i]
+        votes.append(
+            abci.VoteInfo(
+                validator_address=val.address,
+                validator_power=val.voting_power,
+                signed_last_block=not cs.is_absent(),
+            )
+        )
+    return abci.CommitInfo(round=commit.round, votes=votes)
 
 
 def max_data_bytes_for(max_bytes: int, evidence_bytes: int, vals_count: int) -> int:

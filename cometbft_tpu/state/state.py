@@ -118,7 +118,7 @@ def median_time(commit: Commit, validators: ValidatorSet) -> Time:
     weighted.sort()
     median = total // 2
     for nanos, power in weighted:
-        if median < power:
+        if median <= power:
             return Time(nanos // 10**9, nanos % 10**9)
         median -= power
     return Time()

@@ -1,0 +1,176 @@
+"""Transaction & block event indexing (reference: state/txindex/kv/kv.go,
+state/indexer/block/kv/kv.go, state/txindex/indexer_service.go).
+
+The IndexerService subscribes to the EventBus and indexes every committed
+tx (by hash, plus composite event keys for /tx_search) and block header
+events (for /block_search).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.libs.pubsub import Query
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.types.tx import tx_hash
+
+
+class KVTxIndexer:
+    """state/txindex/kv/kv.go: primary record tx.hash -> TxResult, secondary
+    records eventkey/value/height/index -> hash."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    def index(self, height: int, index: int, tx: bytes, result, result_events: dict) -> None:
+        h = tx_hash(tx)
+        record = {
+            "hash": h.hex().upper(),
+            "height": str(height),
+            "index": index,
+            "tx": base64.b64encode(tx).decode(),
+            "tx_result": {
+                "code": result.code,
+                "data": base64.b64encode(result.data).decode(),
+                "log": result.log,
+                "gas_wanted": str(result.gas_wanted),
+                "gas_used": str(result.gas_used),
+            },
+            "events": {k: [str(x) for x in v] for k, v in result_events.items()},
+        }
+        self._db.set(b"tx:" + h, json.dumps(record).encode())
+        for key, values in result_events.items():
+            for v in values:
+                self._db.set(
+                    b"txev:%s=%s:%016d:%08d" % (key.encode(), str(v).encode(), height, index),
+                    h,
+                )
+
+    def get(self, h: bytes) -> dict | None:
+        raw = self._db.get(b"tx:" + h)
+        return json.loads(raw) if raw else None
+
+    def search(self, query: str) -> list[dict]:
+        """Condition-driven scan (kv.go match): supports key=value AND ... plus
+        tx.height ranges via the pubsub Query semantics."""
+        q = Query(query)
+        # Start from the first indexable equality condition.
+        eq = next((c for c in q.conditions if c.op == "="), None)
+        results: list[dict] = []
+        seen: set[bytes] = set()
+        if eq is not None:
+            prefix = b"txev:%s=%s:" % (eq.key.encode(), eq.value.encode())
+            for _, h in self._db.iterator(prefix, prefix + b"\xff"):
+                if h in seen:
+                    continue
+                seen.add(h)
+                rec = self.get(h)
+                if rec and self._matches(rec, q):
+                    results.append(rec)
+        else:
+            for k, raw in self._db.iterator(b"tx:", b"tx;"):
+                rec = json.loads(raw)
+                if self._matches(rec, q):
+                    results.append(rec)
+        results.sort(key=lambda r: (int(r["height"]), r["index"]))
+        return results
+
+    def _matches(self, rec: dict, q: Query) -> bool:
+        attrs = {
+            "tx.hash": [rec["hash"]],
+            "tx.height": [rec["height"]],
+        }
+        for key, values in rec.get("events", {}).items():
+            attrs.setdefault(key, []).extend(values)
+        # re-materialize indexed event attrs from secondary keys is expensive;
+        # store them on the record instead (see index()).
+        return q.matches(attrs)
+
+
+class KVBlockIndexer:
+    """state/indexer/block/kv/kv.go: block.height by event attributes."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    def index(self, height: int, events: dict) -> None:
+        self._db.set(b"blk:%016d" % height, json.dumps(events).encode())
+        for key, values in events.items():
+            for v in values:
+                self._db.set(
+                    b"blkev:%s=%s:%016d" % (key.encode(), str(v).encode(), height), b"%d" % height
+                )
+
+    def search(self, query: str) -> list[int]:
+        q = Query(query)
+        heights = []
+        for k, raw in self._db.iterator(b"blk:", b"blk;"):
+            height = int(k.split(b":")[1])
+            attrs = {"block.height": [str(height)]}
+            for key, values in json.loads(raw).items():
+                attrs.setdefault(key, []).extend(values)
+            if q.matches(attrs):
+                heights.append(height)
+        return sorted(heights)
+
+
+class NullTxIndexer:
+    def index(self, *a, **k):
+        pass
+
+    def get(self, h):
+        return None
+
+    def search(self, query):
+        return []
+
+
+class IndexerService:
+    """state/txindex/indexer_service.go: EventBus → indexers."""
+
+    def __init__(self, tx_indexer, block_indexer, event_bus):
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self._running = False
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        self._running = True
+        tx_sub = self.event_bus.subscribe("indexer-tx", ev.EVENT_QUERY_TX, 1000)
+        hdr_sub = self.event_bus.subscribe(
+            "indexer-hdr", ev.EVENT_QUERY_NEW_BLOCK_HEADER, 1000
+        )
+
+        def tx_pump():
+            while self._running:
+                try:
+                    msg = tx_sub.out.get(timeout=0.25)
+                except Exception:
+                    continue
+                d = msg.data
+                rec_events = {
+                    k: v for k, v in msg.events.items() if k != ev.EVENT_TYPE_KEY
+                }
+                self.tx_indexer.index(d.height, d.index, d.tx, d.result, rec_events)
+
+        def hdr_pump():
+            while self._running:
+                try:
+                    msg = hdr_sub.out.get(timeout=0.25)
+                except Exception:
+                    continue
+                d = msg.data
+                evs = {k: v for k, v in msg.events.items() if k != ev.EVENT_TYPE_KEY}
+                self.block_indexer.index(d.header.height, evs)
+
+        for target in (tx_pump, hdr_pump):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False

@@ -1,0 +1,116 @@
+"""Full-node + JSON-RPC integration: a 2-validator in-process net with node 0
+serving RPC; drive it over HTTP like an external client
+(SURVEY.md §7 "minimum end-to-end slice")."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+
+def _rpc(port, method, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+@pytest.fixture
+def net(tmp_path):
+    pvs = [FilePV(ed25519.gen_priv_key()) for _ in range(2)]
+    doc = GenesisDoc(
+        chain_id="rpc-test",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    doc.validate_and_complete()
+    nodes = []
+    for i, pv in enumerate(pvs):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0" if i == 0 else ""
+        node = Node(cfg, doc, pv, LocalClientCreator(KVStoreApplication()))
+        nodes.append(node)
+
+    def make_broadcast(src):
+        def bcast(msg):
+            for j, other in enumerate(nodes):
+                if j != src:
+                    other.consensus_state.send_peer_message(msg, peer_id=f"n{src}")
+        return bcast
+
+    for i, node in enumerate(nodes):
+        node.consensus_state.set_broadcast(make_broadcast(i))
+    for node in nodes:
+        node.start()
+    yield nodes
+    for node in nodes:
+        node.stop()
+
+
+def test_rpc_surface(net):
+    node0 = net[0]
+    port = node0.rpc_port
+    assert node0.consensus_state.wait_for_height(3, timeout=30)
+
+    st = _rpc(port, "status")
+    assert int(st["sync_info"]["latest_block_height"]) >= 2
+    assert st["validator_info"]["voting_power"] == "10"
+
+    # broadcast_tx_commit waits for inclusion.
+    res = _rpc(port, "broadcast_tx_commit", tx="0x" + b"rk=rv".hex())
+    assert res["deliver_tx"]["code"] == 0
+    committed_height = int(res["height"])
+    assert committed_height >= 1
+
+    blk = _rpc(port, "block", height=str(committed_height))
+    assert blk["block"]["header"]["chain_id"] == "rpc-test"
+    txs = blk["block"]["data"]["txs"]
+    import base64
+
+    assert base64.b64encode(b"rk=rv").decode() in txs
+
+    # abci_query sees the kv pair after commit.
+    q = _rpc(port, "abci_query", path="", data="0x" + b"rk".hex())
+    assert base64.b64decode(q["response"]["value"]) == b"rv"
+
+    # tx indexer: find by hash.
+    from cometbft_tpu.types.tx import tx_hash
+
+    txr = _rpc(port, "tx", hash="0x" + tx_hash(b"rk=rv").hex())
+    assert int(txr["height"]) == committed_height
+
+    # validators / commit / blockchain / consensus introspection.
+    vals = _rpc(port, "validators", height=str(committed_height))
+    assert vals["total"] == "2"
+    cmt = _rpc(port, "commit", height=str(committed_height))
+    assert cmt["signed_header"]["commit"]["height"] == str(committed_height)
+    chain = _rpc(port, "blockchain")
+    assert len(chain["block_metas"]) >= 2
+    dcs = _rpc(port, "dump_consensus_state")
+    assert int(dcs["round_state"]["height"]) >= committed_height
+    health = _rpc(port, "health")
+    assert health == {}
+    gen = _rpc(port, "genesis")
+    assert gen["genesis"]["chain_id"] == "rpc-test"

@@ -140,5 +140,7 @@ def test_median_time_weighting(rig):
             )
         )
     commit = Commit(height=1, round=0, block_id=BlockID(b"\x11" * 32), signatures=sigs)
+    # Go WeightedMedian: median = total/2 = 20; t1 (cum 10) skipped,
+    # t2 reached when remaining median (10) <= weight (10).
     mt = median_time(commit, state.validators)
-    assert mt == Time(1700000030, 0)
+    assert mt == Time(1700000020, 0)
