@@ -1,69 +1,125 @@
-"""Round benchmark: the north-star configs from BASELINE.md on the real chip.
+"""Round benchmark: the north-star configs from BASELINE.md.
 
-Prints exactly ONE JSON line:
+Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
-Headline metric: wall time to verify a 10,240-signature commit (10k-validator
-VerifyCommitLight analog: ZIP-215 batch verification on device) PLUS the
-64k-leaf block Merkle root — the full "verify a block's crypto" step.
+Headline metric: wall time to verify a 10,240-signature commit (the
+10k-validator VerifyCommitLight analog — ZIP-215 batch verification on
+device) PLUS the 64k-leaf block Merkle root: the full "verify a block's
+crypto" step.
 
-vs_baseline: the reference's Go path cost for the same work, derived from its
-published numbers (BASELINE.md): RFC-6962 Merkle at 77.7 us / 100 leaves
-(crypto/merkle/tree.go:42) scales to ~50.9 ms for 64k leaves; curve25519-voi
-batch verification runs ~2x single-verify throughput (crypto/ed25519
-bench shapes), i.e. ~32 us/sig on server cores -> ~327 ms for 10,240 sigs.
-Baseline total: ~378 ms. vs_baseline = baseline_ms / measured_ms (>1 = faster
-than the reference path).
+vs_baseline: the reference's Go path cost for the same work, derived from
+its published numbers (BASELINE.md): RFC-6962 Merkle at 77.7 us / 100 leaves
+(crypto/merkle/tree.go:42) -> ~50.9 ms for 64k leaves; curve25519-voi batch
+verify ~2x single-verify throughput -> ~32 us/sig -> ~327 ms for 10,240
+sigs. Baseline total ~378 ms; vs_baseline = baseline_ms / measured_ms
+(>1 = faster than the reference path).
+
+Robustness: the default-platform (TPU) attempt runs in a subprocess with a
+timeout; if the TPU tunnel stalls, a CPU-pinned subprocess produces the line
+instead, so the driver always gets a result.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
+BASELINE_MS = 10240 * 0.032 + 50.9
+TPU_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_TPU_TIMEOUT", "480"))
+CPU_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_CPU_TIMEOUT", "1500"))
 
-def main() -> None:
-    # Run on the default platform (TPU under axon; CPU elsewhere). The
-    # verification workload is packed host-side exactly as production does.
+
+def worker() -> None:
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Env alone has been observed to still init the TPU plugin; pin it.
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
+    from cometbft_tpu.ops import ed25519_kernel as ek
     from cometbft_tpu.ops import merkle_kernel as mk
     from cometbft_tpu.ops.sharded import make_example_batch
-    from cometbft_tpu.ops import ed25519_kernel as ek
 
     n_sigs = 10240
     n_leaves = 65536
 
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+    t0 = time.time()
     operands = tuple(np.asarray(o) for o in make_example_batch(n_sigs))
+    print(f"packed batch in {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
     verify = ek._compiled(n_sigs)
     txs = [b"bench-tx-%08d" % i for i in range(n_leaves)]
 
-    # Warmup / compile.
+    t0 = time.time()
     ok = np.asarray(jax.block_until_ready(verify(*operands)))
+    print(f"verify compile+run {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
     assert ok.all(), "bench batch must verify"
-    mk.merkle_root(txs[:1024])
+    t0 = time.time()
+    digests = mk.hash_leaves_device(txs)
+    root = mk.merkle_root_pow2(digests)
+    print(f"merkle compile+run {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+    from cometbft_tpu.crypto.merkle import hash_from_byte_slices
 
-    # Timed: 10,240-sig verify + 64k-leaf merkle root (3 reps, min).
+    assert root == hash_from_byte_slices(txs), "device merkle root != host root"
+
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         jax.block_until_ready(verify(*operands))
-        mk.merkle_root(txs)
+        mk.merkle_root_pow2(mk.hash_leaves_device(txs))
         best = min(best, time.perf_counter() - t0)
 
     measured_ms = best * 1000.0
-    baseline_ms = 10240 * 0.032 + 50.9  # Go batch-verify + merkle (see module doc)
     print(
         json.dumps(
             {
                 "metric": "verify_10k_commit_plus_64k_merkle_ms",
                 "value": round(measured_ms, 3),
                 "unit": "ms",
-                "vs_baseline": round(baseline_ms / measured_ms, 3),
+                "vs_baseline": round(BASELINE_MS / measured_ms, 3),
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def main() -> int:
+    here = os.path.abspath(__file__)
+    attempts = [({}, TPU_TIMEOUT_S), ({"JAX_PLATFORMS": "cpu"}, CPU_TIMEOUT_S)]
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        attempts = attempts[1:]
+    for extra_env, timeout_s in attempts:
+        env = dict(os.environ, **extra_env)
+        try:
+            res = subprocess.run(
+                [sys.executable, "-u", here, "--worker"],
+                capture_output=True,
+                timeout=timeout_s,
+                env=env,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench attempt timed out after {timeout_s}s (env {extra_env}); "
+                f"falling back",
+                file=sys.stderr,
+            )
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                print(line)
+                return 0
+        print(res.stderr[-2000:], file=sys.stderr)
+    print("bench: all attempts failed", file=sys.stderr)
+    return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        sys.exit(main())

@@ -1,0 +1,7 @@
+"""Blocksync ("fast sync") — catch-up by downloading blocks from peers
+(reference: blocksync/, 1,184 LoC)."""
+
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+__all__ = ["BlockPool", "BlocksyncReactor"]

@@ -1,0 +1,178 @@
+"""Blocksync reactor (reference: blocksync/reactor.go, channel 0x40).
+
+The sync loop validates each block with the NEXT block's LastCommit via
+VerifyCommitLight — the TPU-batched hot path (reactor.go:355-400, call at
+:360, SURVEY.md §3.3) — then applies it; switches to consensus when caught
+up.
+
+Wire (proto/tendermint/blocksync/types.proto): Message oneof
+{block_request=1{height}, no_block_response=2{height}, block_response=3
+{block}, status_request=4, status_response=5{height, base}}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.reactor import BLOCKSYNC_CHANNEL, Reactor
+from cometbft_tpu.types.block import Block, BlockID
+from cometbft_tpu.wire import proto as wire
+
+
+def _encode(tag: int, inner: bytes) -> bytes:
+    return wire.field_message(tag, inner, emit_empty=True)
+
+
+def encode_block_request(height: int) -> bytes:
+    return _encode(1, wire.field_varint(1, height))
+
+
+def encode_no_block_response(height: int) -> bytes:
+    return _encode(2, wire.field_varint(1, height))
+
+
+def encode_block_response(block: Block) -> bytes:
+    return _encode(3, wire.field_message(1, block.encode(), emit_empty=True))
+
+
+def encode_status_request() -> bytes:
+    return _encode(4, b"")
+
+
+def encode_status_response(height: int, base: int) -> bytes:
+    return _encode(5, wire.field_varint(1, height) + wire.field_varint(2, base))
+
+
+def decode_message(data: bytes):
+    f = wire.decode_fields(data)
+    if 1 in f:
+        return ("block_request", wire.get_varint(wire.decode_fields(wire.get_bytes(f, 1)), 1))
+    if 2 in f:
+        return ("no_block_response", wire.get_varint(wire.decode_fields(wire.get_bytes(f, 2)), 1))
+    if 3 in f:
+        inner = wire.decode_fields(wire.get_bytes(f, 3))
+        return ("block_response", Block.decode(wire.get_bytes(inner, 1)))
+    if 4 in f:
+        return ("status_request", None)
+    if 5 in f:
+        inner = wire.decode_fields(wire.get_bytes(f, 5))
+        return ("status_response", (wire.get_varint(inner, 1), wire.get_varint(inner, 2)))
+    raise ValueError("unknown blocksync message")
+
+
+class BlocksyncReactor(Reactor):
+    """blocksync/reactor.go Reactor."""
+
+    def __init__(self, state, block_exec, block_store, block_sync: bool, on_caught_up=None):
+        super().__init__("BLOCKSYNC")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.block_sync_enabled = block_sync
+        self.on_caught_up = on_caught_up  # fn(state) -> switch to consensus
+        self.pool = BlockPool(state.last_block_height + 1, self._send_request)
+        self._running = False
+        self.synced = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                BLOCKSYNC_CHANNEL, priority=5, send_queue_capacity=1000,
+                recv_message_capacity=50 * 1024 * 1024,
+            )
+        ]
+
+    def start(self) -> None:
+        self._running = True
+        if self.block_sync_enabled:
+            threading.Thread(target=self._pool_routine, daemon=True).start()
+
+    def stop(self) -> None:
+        self._running = False
+
+    # -- peers ----------------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        peer.try_send(
+            BLOCKSYNC_CHANNEL,
+            encode_status_response(self.block_store.height(), self.block_store.base()),
+        )
+        peer.try_send(BLOCKSYNC_CHANNEL, encode_status_request())
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        kind, payload = decode_message(msg_bytes)
+        if kind == "block_request":
+            block = self.block_store.load_block(payload)
+            if block is not None:
+                peer.try_send(BLOCKSYNC_CHANNEL, encode_block_response(block))
+            else:
+                peer.try_send(BLOCKSYNC_CHANNEL, encode_no_block_response(payload))
+        elif kind == "block_response":
+            self.pool.add_block(peer.id, payload)
+        elif kind == "status_request":
+            peer.try_send(
+                BLOCKSYNC_CHANNEL,
+                encode_status_response(self.block_store.height(), self.block_store.base()),
+            )
+        elif kind == "status_response":
+            height, base = payload
+            self.pool.set_peer_range(peer.id, base, height)
+        elif kind == "no_block_response":
+            pass
+
+    def _send_request(self, peer_id: str, height: int) -> None:
+        peer = self.switch.get_peer(peer_id) if self.switch else None
+        if peer is not None:
+            peer.try_send(BLOCKSYNC_CHANNEL, encode_block_request(height))
+
+    # -- sync loop (reactor.go:280-410 poolRoutine) ---------------------------
+
+    def _pool_routine(self) -> None:
+        status_tick = 0.0
+        while self._running and not self.synced:
+            self.pool.make_requests()
+            now = time.monotonic()
+            if now - status_tick > 10:
+                status_tick = now
+                if self.switch:
+                    self.switch.broadcast(BLOCKSYNC_CHANNEL, encode_status_request())
+            if self._try_sync_one():
+                continue  # immediately try the next pair
+            if self.pool.is_caught_up() and self.pool.max_peer_height > 0:
+                self.synced = True
+                if self.on_caught_up:
+                    self.on_caught_up(self.state)
+                return
+            time.sleep(0.01)
+
+    def _try_sync_one(self) -> bool:
+        """reactor.go:340-400 trySync: verify `first` with `second.LastCommit`
+        (VerifyCommitLight — batched on device), then apply."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = first.make_part_set()
+        first_id = BlockID(first.hash(), first_parts.header())
+        try:
+            # ★ the TPU call (types/validation.go:59 via blocksync/reactor.go:360)
+            self.state.validators.verify_commit_light(
+                self.state.chain_id, first_id, first.header.height, second.last_commit
+            )
+            self.block_exec.validate_block(self.state, first)
+        except Exception:
+            bad_peer = self.pool.redo_request(first.header.height)
+            if bad_peer and self.switch:
+                peer = self.switch.get_peer(bad_peer)
+                if peer:
+                    self.switch.stop_peer_for_error(peer, "sent us an invalid block")
+            return False
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
+        self.pool.pop_request()
+        return True

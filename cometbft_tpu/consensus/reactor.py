@@ -1,0 +1,258 @@
+"""Consensus gossip reactor (reference: consensus/reactor.go, 1,796 LoC).
+
+Four channels (reactor.go:25-28): state 0x20 (round-step + has-vote
+broadcasts), data 0x21 (proposals + block parts), vote 0x22, vote-set-bits
+0x23. Per-peer gossip threads push block parts and votes a peer is missing
+(gossipDataRoutine :535, gossipVotesRoutine :694); PeerState tracks what
+each peer has seen.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.consensus import messages as cmsg
+from cometbft_tpu.consensus.cstypes import STEP_NAMES
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.reactor import (
+    CONSENSUS_DATA_CHANNEL,
+    CONSENSUS_STATE_CHANNEL,
+    CONSENSUS_VOTE_CHANNEL,
+    CONSENSUS_VOTE_SET_BITS_CHANNEL,
+    Reactor,
+)
+from cometbft_tpu.types.block import PRECOMMIT_TYPE
+
+
+class PeerState:
+    """reactor.go PeerState: the peer's view of consensus."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.last_commit_round = 0
+        self._mtx = threading.Lock()
+        self._sent_parts: set = set()
+        self._sent_votes: set = set()
+
+    def apply_new_round_step(self, msg: cmsg.NewRoundStepMessage) -> None:
+        with self._mtx:
+            if (msg.height, msg.round) != (self.height, self.round):
+                self._sent_parts.clear()
+                self._sent_votes.clear()
+            self.height = msg.height
+            self.round = msg.round
+            self.step = msg.step
+            self.last_commit_round = msg.last_commit_round
+
+    def mark_part_sent(self, height: int, index: int) -> bool:
+        with self._mtx:
+            key = (height, index)
+            if key in self._sent_parts:
+                return False
+            self._sent_parts.add(key)
+            return True
+
+    def mark_vote_sent(self, key) -> bool:
+        with self._mtx:
+            if key in self._sent_votes:
+                return False
+            self._sent_votes.add(key)
+            return True
+
+
+class ConsensusReactor(Reactor):
+    """consensus/reactor.go Reactor."""
+
+    def __init__(self, consensus_state, gossip_sleep: float = 0.1):
+        super().__init__("CONSENSUS")
+        self.cs = consensus_state
+        self.gossip_sleep = gossip_sleep
+        self.peer_states: dict[str, PeerState] = {}
+        self._running = False
+        # Own messages from the state machine get gossiped.
+        self.cs.set_broadcast(self._broadcast_own_message)
+
+    def get_channels(self):
+        """reactor.go:139-175 channel descriptors."""
+        return [
+            ChannelDescriptor(CONSENSUS_STATE_CHANNEL, priority=6, send_queue_capacity=100),
+            ChannelDescriptor(CONSENSUS_DATA_CHANNEL, priority=10, send_queue_capacity=100),
+            ChannelDescriptor(CONSENSUS_VOTE_CHANNEL, priority=7, send_queue_capacity=100),
+            ChannelDescriptor(CONSENSUS_VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
+        ]
+
+    def start(self) -> None:
+        self._running = True
+        threading.Thread(target=self._broadcast_round_step_routine, daemon=True).start()
+
+    def stop(self) -> None:
+        self._running = False
+
+    # -- peers ----------------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        ps = PeerState(peer)
+        self.peer_states[peer.id] = ps
+        peer.set("consensus_peer_state", ps)
+        self._send_round_step(peer)
+        threading.Thread(target=self._gossip_routine, args=(ps,), daemon=True).start()
+
+    def remove_peer(self, peer, reason) -> None:
+        self.peer_states.pop(peer.id, None)
+
+    # -- receive --------------------------------------------------------------
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        msg = cmsg.decode_consensus_message(msg_bytes)
+        ps = self.peer_states.get(peer.id)
+        if chan_id == CONSENSUS_STATE_CHANNEL:
+            if isinstance(msg, cmsg.NewRoundStepMessage) and ps:
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, cmsg.HasVoteMessage) and ps:
+                ps.mark_vote_sent((msg.height, msg.round, msg.type, msg.index))
+        elif chan_id in (CONSENSUS_DATA_CHANNEL, CONSENSUS_VOTE_CHANNEL):
+            self.cs.send_peer_message(msg, peer_id=peer.id)
+        elif chan_id == CONSENSUS_VOTE_SET_BITS_CHANNEL:
+            pass  # maj23 answers — bookkeeping only in this implementation
+
+    # -- own-message gossip ---------------------------------------------------
+
+    def _broadcast_own_message(self, msg) -> None:
+        if self.switch is None:
+            return
+        data = cmsg.encode_consensus_message(msg)
+        if isinstance(msg, (cmsg.ProposalMessage, cmsg.BlockPartMessage)):
+            self.switch.broadcast(CONSENSUS_DATA_CHANNEL, data)
+        elif isinstance(msg, cmsg.VoteMessage):
+            self.switch.broadcast(CONSENSUS_VOTE_CHANNEL, data)
+
+    # -- broadcast round steps (reactor.go broadcastNewRoundStepMessage) ------
+
+    def _broadcast_round_step_routine(self) -> None:
+        last = None
+        while self._running:
+            rs = self.cs.rs
+            cur = (rs.height, rs.round, rs.step)
+            if cur != last and self.switch is not None:
+                last = cur
+                msg = self._round_step_msg(rs)
+                self.switch.broadcast(
+                    CONSENSUS_STATE_CHANNEL, cmsg.encode_consensus_message(msg)
+                )
+            time.sleep(0.02)
+
+    def _round_step_msg(self, rs) -> cmsg.NewRoundStepMessage:
+        return cmsg.NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=rs.step,
+            seconds_since_start_time=0,
+            last_commit_round=rs.last_commit.round if rs.last_commit else 0,
+        )
+
+    def _send_round_step(self, peer) -> None:
+        peer.try_send(
+            CONSENSUS_STATE_CHANNEL,
+            cmsg.encode_consensus_message(self._round_step_msg(self.cs.rs)),
+        )
+
+    # -- per-peer gossip (reactor.go:535 gossipDataRoutine + :694 votes) ------
+
+    def _gossip_routine(self, ps: PeerState) -> None:
+        while self._running and ps.peer.id in self.peer_states:
+            try:
+                advanced = self._gossip_once(ps)
+            except Exception:
+                advanced = False
+            if not advanced:
+                time.sleep(self.gossip_sleep)
+
+    def _gossip_once(self, ps: PeerState) -> bool:
+        rs = self.cs.rs
+        # Peer behind: feed them committed block parts + the seen commit's
+        # precommits so they can catch up (gossipDataForCatchup).
+        if 0 < ps.height < rs.height:
+            block_meta = self.cs.block_store.load_block_meta(ps.height)
+            if block_meta is None:
+                return False
+            sent = False
+            for i in range(block_meta.block_id.part_set_header.total):
+                if ps.mark_part_sent(ps.height, i):
+                    part = self.cs.block_store.load_block_part(ps.height, i)
+                    if part is not None:
+                        ps.peer.try_send(
+                            CONSENSUS_DATA_CHANNEL,
+                            cmsg.encode_consensus_message(
+                                cmsg.BlockPartMessage(ps.height, ps.round, part)
+                            ),
+                        )
+                        sent = True
+            seen_commit = self.cs.block_store.load_seen_commit(ps.height)
+            if seen_commit is not None:
+                from cometbft_tpu.types.vote import Vote
+
+                for idx, cs_sig in enumerate(seen_commit.signatures):
+                    if cs_sig.is_absent():
+                        continue
+                    key = ("commit", ps.height, idx)
+                    if not ps.mark_vote_sent(key):
+                        continue
+                    vote = Vote(
+                        type=PRECOMMIT_TYPE,
+                        height=seen_commit.height,
+                        round=seen_commit.round,
+                        block_id=cs_sig.block_id(seen_commit.block_id),
+                        timestamp=cs_sig.timestamp,
+                        validator_address=cs_sig.validator_address,
+                        validator_index=idx,
+                        signature=cs_sig.signature,
+                    )
+                    ps.peer.try_send(
+                        CONSENSUS_VOTE_CHANNEL,
+                        cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
+                    )
+                    sent = True
+            return sent
+        # Same height: re-send our proposal/parts and known votes they lack.
+        if ps.height == rs.height:
+            sent = False
+            if rs.proposal is not None and ps.round == rs.round:
+                key = ("proposal", rs.height, rs.round)
+                if ps.mark_vote_sent(key):
+                    ps.peer.try_send(
+                        CONSENSUS_DATA_CHANNEL,
+                        cmsg.encode_consensus_message(cmsg.ProposalMessage(rs.proposal)),
+                    )
+                    sent = True
+                if rs.proposal_block_parts is not None:
+                    for i in range(rs.proposal_block_parts.total):
+                        part = rs.proposal_block_parts.get_part(i)
+                        if part is not None and ps.mark_part_sent(rs.height, i):
+                            ps.peer.try_send(
+                                CONSENSUS_DATA_CHANNEL,
+                                cmsg.encode_consensus_message(
+                                    cmsg.BlockPartMessage(rs.height, rs.round, part)
+                                ),
+                            )
+                            sent = True
+            if rs.votes is not None:
+                for vote_set in (
+                    rs.votes.prevotes(rs.round),
+                    rs.votes.precommits(rs.round),
+                ):
+                    if vote_set is None:
+                        continue
+                    for vote in vote_set.list_votes():
+                        key = (vote.height, vote.round, vote.type, vote.validator_index)
+                        if ps.mark_vote_sent(key):
+                            ps.peer.try_send(
+                                CONSENSUS_VOTE_CHANNEL,
+                                cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
+                            )
+                            sent = True
+            return sent
+        return False

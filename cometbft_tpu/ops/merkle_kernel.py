@@ -123,6 +123,33 @@ def merkle_root(leaves: list[bytes]) -> bytes:
     return sha.digest_words_to_bytes(root)[0]
 
 
+@functools.lru_cache(maxsize=None)
+def _tree_root_jit(n: int):
+    """ONE compiled program reducing uint32[8, n] (n a power of two) leaf
+    digests to the root: the level loop unrolls inside jit (log2(n) levels,
+    ~100 ops each), so a 64k-leaf tree costs one compile + one dispatch."""
+
+    def root(leaves):
+        cur = leaves
+        while cur.shape[1] > 1:
+            cur = _inner_core(cur[:, 0::2], cur[:, 1::2])
+        return cur
+
+    return jax.jit(root)
+
+
+def merkle_root_pow2(leaf_digests: np.ndarray) -> bytes:
+    """Root from uint32[8, n] leaf digests, n a power of two — the bench/
+    sharded fast path."""
+    n = leaf_digests.shape[1]
+    if n & (n - 1):
+        raise ValueError("merkle_root_pow2 requires a power-of-two leaf count")
+    if n == 1:
+        return sha.digest_words_to_bytes(leaf_digests)[0]
+    out = _tree_root_jit(n)(jnp.asarray(leaf_digests))
+    return sha.digest_words_to_bytes(np.asarray(out))[0]
+
+
 def merkle_levels_bytes(leaves: list[bytes]) -> list[list[bytes]]:
     """All levels as byte digests (bottom-up) — the proof-building form used
     by crypto/merkle.ProofsFromByteSlices (proof.go:35)."""

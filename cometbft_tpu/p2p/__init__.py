@@ -1,0 +1,12 @@
+"""P2P: the distributed communication backend (reference: p2p/, 8,379 LoC).
+
+An encrypted, multiplexed, rate-limited TCP mesh with gossip semantics —
+point-to-point send/broadcast over per-reactor logical channels
+(SURVEY.md §2.8). Consensus traffic stays host-side (DCN analog); the TPU
+interconnect is used only inside the verification kernels.
+"""
+
+from cometbft_tpu.p2p.key import NodeKey, node_id_from_pub_key
+from cometbft_tpu.p2p.reactor import Reactor
+
+__all__ = ["NodeKey", "Reactor", "node_id_from_pub_key"]

@@ -1,0 +1,1 @@
+"""Connection layer: SecretConnection + MConnection (reference: p2p/conn/)."""

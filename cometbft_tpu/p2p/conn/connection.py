@@ -1,0 +1,265 @@
+"""Multiplexed connection (reference: p2p/conn/connection.go, 918 LoC).
+
+N logical channels over one (secret) connection: per-channel priority queues
+with recently-sent fairness accounting, global send/recv rate limiting,
+ping/pong keep-alive, 10ms flush throttle. Packets are length-delimited
+proto: Packet oneof {ping=1, pong=2, msg=3{channel_id, eof, data}}
+(proto/tendermint/p2p/conn.proto); messages over max packet size are split
+and reassembled at EOF markers.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+
+from cometbft_tpu.wire import proto as wire
+
+DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
+DEFAULT_SEND_RATE = 512000 * 10
+DEFAULT_RECV_RATE = 512000 * 10
+PING_INTERVAL = 60.0
+PONG_TIMEOUT = 45.0
+FLUSH_THROTTLE = 0.01
+MAX_MSG_SIZE = 104857600
+
+
+class ChannelDescriptor:
+    """conn/connection.go ChannelDescriptor."""
+
+    def __init__(
+        self,
+        channel_id: int,
+        priority: int = 1,
+        send_queue_capacity: int = 100,
+        recv_message_capacity: int = 22020096,
+    ):
+        self.id = channel_id
+        self.priority = priority
+        self.send_queue_capacity = send_queue_capacity
+        self.recv_message_capacity = recv_message_capacity
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: queue.Queue[bytes] = queue.Queue(desc.send_queue_capacity)
+        self.sending: bytes | None = None
+        self.recently_sent = 0
+        self.recving = b""
+
+
+class _TokenBucket:
+    """libs/flowrate analog: byte-rate throttling."""
+
+    def __init__(self, rate: int):
+        self.rate = rate
+        self.allowance = float(rate)
+        self.last = time.monotonic()
+        self._mtx = threading.Lock()
+
+    def limit(self, n: int) -> None:
+        if self.rate <= 0:
+            return
+        with self._mtx:
+            now = time.monotonic()
+            self.allowance = min(
+                self.rate, self.allowance + (now - self.last) * self.rate
+            )
+            self.last = now
+            self.allowance -= n
+            if self.allowance < 0:
+                time.sleep(-self.allowance / self.rate)
+                self.allowance = 0
+
+
+class MConnection:
+    """conn/connection.go:78 MConnection."""
+
+    def __init__(
+        self,
+        conn,
+        channel_descs: list[ChannelDescriptor],
+        on_receive,
+        on_error,
+        max_packet_msg_payload_size: int = DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE,
+        send_rate: int = DEFAULT_SEND_RATE,
+        recv_rate: int = DEFAULT_RECV_RATE,
+    ):
+        self._conn = conn
+        self.channels = {d.id: _Channel(d) for d in channel_descs}
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self.max_payload = max_packet_msg_payload_size
+        self._send_limiter = _TokenBucket(send_rate)
+        self._recv_limiter = _TokenBucket(recv_rate)
+        self._send_signal = threading.Event()
+        self._running = False
+        self._pong_pending = False
+        self._last_msg_recv = time.monotonic()
+
+    def start(self) -> None:
+        self._running = True
+        threading.Thread(target=self._send_routine, daemon=True).start()
+        threading.Thread(target=self._recv_routine, daemon=True).start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._send_signal.set()
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+    # -- sending (conn/connection.go:422 sendRoutine) -------------------------
+
+    def send(self, channel_id: int, msg_bytes: bytes) -> bool:
+        """Blocking enqueue (connection.go Send)."""
+        ch = self.channels.get(channel_id)
+        if ch is None or not self._running:
+            return False
+        try:
+            ch.send_queue.put(msg_bytes, timeout=10)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def try_send(self, channel_id: int, msg_bytes: bytes) -> bool:
+        """Non-blocking enqueue (connection.go TrySend)."""
+        ch = self.channels.get(channel_id)
+        if ch is None or not self._running:
+            return False
+        try:
+            ch.send_queue.put_nowait(msg_bytes)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        while self._running:
+            try:
+                sent_any = self._send_some_packets()
+                if self._pong_pending:
+                    self._write_packet(wire.field_message(2, b"", emit_empty=True))
+                    self._pong_pending = False
+                if time.monotonic() - last_ping > PING_INTERVAL:
+                    self._write_packet(wire.field_message(1, b"", emit_empty=True))
+                    last_ping = time.monotonic()
+                if not sent_any:
+                    self._send_signal.wait(FLUSH_THROTTLE)
+                    self._send_signal.clear()
+            except Exception as e:
+                self._running = False
+                if self.on_error:
+                    self.on_error(e)
+                return
+
+    def _send_some_packets(self) -> bool:
+        """Up to a batch of packets, least recently-sent channel first
+        (connection.go sendSomePacketMsgs/sendPacketMsg)."""
+        sent = False
+        for _ in range(32):
+            ch = self._next_channel_to_send()
+            if ch is None:
+                break
+            self._send_packet_for(ch)
+            sent = True
+        return sent
+
+    def _next_channel_to_send(self):
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if ch.sending is None:
+                try:
+                    ch.sending = ch.send_queue.get_nowait()
+                except queue.Empty:
+                    continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_packet_for(self, ch: _Channel) -> None:
+        data = ch.sending
+        chunk, rest = data[: self.max_payload], data[self.max_payload :]
+        eof = len(rest) == 0
+        pkt = (
+            wire.field_varint(1, ch.desc.id)
+            + wire.field_bool(2, eof)
+            + wire.field_bytes(3, chunk)
+        )
+        self._write_packet(wire.field_message(3, pkt, emit_empty=True))
+        ch.recently_sent += len(chunk)
+        # decay fairness counter
+        ch.recently_sent = int(ch.recently_sent * 0.8)
+        ch.sending = rest if rest else None
+
+    def _write_packet(self, packet_fields: bytes) -> None:
+        framed = wire.length_delimited(packet_fields)
+        self._send_limiter.limit(len(framed))
+        self._conn.sendall(framed) if hasattr(self._conn, "sendall") else self._conn.write(framed)
+
+    # -- receiving (conn/connection.go recvRoutine) ---------------------------
+
+    def _recv_routine(self) -> None:
+        while self._running:
+            try:
+                pkt = self._read_packet()
+                self._last_msg_recv = time.monotonic()
+                f = wire.decode_fields(pkt)
+                if 1 in f:  # ping
+                    self._pong_pending = True
+                    self._send_signal.set()
+                elif 2 in f:  # pong
+                    pass
+                elif 3 in f:
+                    mf = wire.decode_fields(wire.get_bytes(f, 3))
+                    chan_id = wire.get_uvarint(mf, 1)
+                    eof = wire.get_bool(mf, 2)
+                    data = wire.get_bytes(mf, 3)
+                    ch = self.channels.get(chan_id)
+                    if ch is None:
+                        raise ValueError(f"unknown channel {chan_id:#x}")
+                    ch.recving += data
+                    if len(ch.recving) > ch.desc.recv_message_capacity:
+                        raise ValueError("received message exceeds channel capacity")
+                    if eof:
+                        msg, ch.recving = ch.recving, b""
+                        self.on_receive(chan_id, msg)
+            except Exception as e:
+                was_running = self._running
+                self._running = False
+                if was_running and self.on_error:
+                    self.on_error(e)
+                return
+
+    def _read_packet(self) -> bytes:
+        hdr = b""
+        while True:
+            b = self._read_exact(1)
+            hdr += b
+            if not (b[0] & 0x80):
+                break
+            if len(hdr) > 10:
+                raise ValueError("packet length varint too long")
+        ln, _ = wire.decode_uvarint(hdr, 0)
+        if ln > MAX_MSG_SIZE:
+            raise ValueError("packet too large")
+        self._recv_limiter.limit(ln)
+        return self._read_exact(ln)
+
+    def _read_exact(self, n: int) -> bytes:
+        if hasattr(self._conn, "read_exact"):
+            return self._conn.read_exact(n)
+        out = b""
+        while len(out) < n:
+            chunk = self._conn.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            out += chunk
+        return out

@@ -1,0 +1,191 @@
+"""Authenticated encrypted transport (reference: p2p/conn/secret_connection.go).
+
+Station-to-Station handshake: exchange ephemeral X25519 keys (length-
+delimited BytesValue, secret_connection.go:299-320), Diffie-Hellman, derive
+recv/send keys + a 32-byte challenge via HKDF-SHA256 with the
+"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN" info label
+(:51,:335-360 — key order decided by sorted ephemeral pubkeys), sign the
+challenge with the node's ed25519 key and exchange AuthSig messages over the
+now-encrypted channel (:411-425).
+
+Framing (:35-38,:185-260): ChaCha20-Poly1305 over 1028-byte frames
+(4-byte LE length + 1024 data max), 12-byte nonces with a little-endian
+64-bit counter in the low bytes, separate counters per direction.
+
+DEVIATION from the reference: the challenge is taken from the HKDF output
+(as in pre-0.34 Tendermint) instead of a merlin/STROBE transcript hash —
+structurally identical STS security, but not wire-interoperable with Go
+peers (SURVEY.md §7 hard part 5 defers exact transcript interop).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto.encoding import pub_key_from_proto, pub_key_to_proto
+from cometbft_tpu.wire import proto as wire
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_SIZE_OVERHEAD = 16
+KEY_AND_CHALLENGE_GEN = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+def _hkdf_sha256(secret: bytes, info: bytes, length: int) -> bytes:
+    """RFC 5869 with empty salt (golang.org/x/crypto/hkdf defaults)."""
+    prk = hmac.new(b"\x00" * 32, secret, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+class SecretConnection:
+    """p2p/conn/secret_connection.go:92 MakeSecretConnection."""
+
+    def __init__(self, conn, loc_priv_key):
+        self._conn = conn
+        self.loc_priv_key = loc_priv_key
+        self.loc_pub_key = loc_priv_key.pub_key()
+        self.rem_pub_key = None
+        self._recv_buffer = b""
+        self._send_nonce = 0
+        self._recv_nonce = 0
+        self._handshake()
+
+    # -- handshake ------------------------------------------------------------
+
+    def _handshake(self) -> None:
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+        # Exchange ephemeral pubkeys: length-delimited BytesValue{value=1}.
+        self._write_raw(wire.length_delimited(wire.field_bytes(1, eph_pub)))
+        rem_eph_pub = self._read_delimited_bytes_value()
+        if len(rem_eph_pub) != 32:
+            raise SecretConnectionError("invalid ephemeral pubkey size")
+        # Sorted ephemeral keys pick the HKDF key order.
+        lo, hi = sorted([eph_pub, rem_eph_pub])
+        loc_is_least = eph_pub == lo
+        dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        okm = _hkdf_sha256(dh_secret, KEY_AND_CHALLENGE_GEN, 96)
+        if loc_is_least:
+            recv_secret, send_secret = okm[:32], okm[32:64]
+        else:
+            send_secret, recv_secret = okm[:32], okm[32:64]
+        challenge = okm[64:96]
+        self._send_aead = ChaCha20Poly1305(send_secret)
+        self._recv_aead = ChaCha20Poly1305(recv_secret)
+        # Authenticate: sign the challenge, swap AuthSig over the sealed channel.
+        sig = self.loc_priv_key.sign(challenge)
+        auth_msg = wire.field_message(
+            1, pub_key_to_proto(self.loc_pub_key), emit_empty=True
+        ) + wire.field_bytes(2, sig)
+        self.write(wire.length_delimited(auth_msg))
+        their_auth = self._read_auth_sig()
+        rem_pub, rem_sig = their_auth
+        if not rem_pub.verify_signature(challenge, rem_sig):
+            raise SecretConnectionError("challenge verification failed")
+        self.rem_pub_key = rem_pub
+
+    def _read_auth_sig(self):
+        buf = self.read(DATA_MAX_SIZE)
+        ln, pos = wire.decode_uvarint(buf, 0)
+        while len(buf) - pos < ln:
+            buf += self.read(DATA_MAX_SIZE)
+        f = wire.decode_fields(buf[pos : pos + ln])
+        return pub_key_from_proto(wire.get_bytes(f, 1)), wire.get_bytes(f, 2)
+
+    def _read_delimited_bytes_value(self) -> bytes:
+        hdr = self._read_raw(1)
+        while hdr[-1] & 0x80:
+            hdr += self._read_raw(1)
+        ln, _ = wire.decode_uvarint(hdr, 0)
+        body = self._read_raw(ln)
+        f = wire.decode_fields(body)
+        return wire.get_bytes(f, 1)
+
+    # -- sealed IO ------------------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        """Chunk into sealed frames (secret_connection.go:185-225)."""
+        n = 0
+        while data:
+            chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
+            frame = struct.pack("<I", len(chunk)) + chunk
+            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+            nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", self._send_nonce)
+            self._send_nonce += 1
+            sealed = self._send_aead.encrypt(nonce, frame, None)
+            self._write_raw(sealed)
+            n += len(chunk)
+        return n
+
+    def read(self, max_bytes: int = DATA_MAX_SIZE) -> bytes:
+        """One frame's worth (buffered; secret_connection.go:229-260)."""
+        if self._recv_buffer:
+            out, self._recv_buffer = (
+                self._recv_buffer[:max_bytes],
+                self._recv_buffer[max_bytes:],
+            )
+            return out
+        sealed = self._read_raw(TOTAL_FRAME_SIZE + AEAD_SIZE_OVERHEAD)
+        nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", self._recv_nonce)
+        self._recv_nonce += 1
+        try:
+            frame = self._recv_aead.decrypt(nonce, sealed, None)
+        except Exception as e:
+            raise SecretConnectionError(f"failed to decrypt frame: {e}") from e
+        (length,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
+        if length > DATA_MAX_SIZE:
+            raise SecretConnectionError("chunk length exceeds maximum")
+        data = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+        out, self._recv_buffer = data[:max_bytes], data[max_bytes:]
+        return out
+
+    def read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.read(n - len(out))
+            if not chunk:
+                raise SecretConnectionError("connection closed")
+            out += chunk
+        return out
+
+    # -- raw socket -----------------------------------------------------------
+
+    def _write_raw(self, data: bytes) -> None:
+        self._conn.sendall(data)
+
+    def _read_raw(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._conn.recv(n - len(out))
+            if not chunk:
+                raise SecretConnectionError("connection closed")
+            out += chunk
+        return out
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass

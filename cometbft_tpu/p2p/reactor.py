@@ -1,0 +1,52 @@
+"""Reactor interface (reference: p2p/base_reactor.go:15).
+
+Every networked subsystem implements this and registers on the Switch with
+reserved global channel byte IDs (SURVEY.md §1): PEX 0x00, consensus
+0x20-0x23, mempool 0x30, evidence 0x38, blocksync 0x40, statesync 0x60-0x61.
+"""
+
+from __future__ import annotations
+
+
+class Reactor:
+    def __init__(self, name: str):
+        self.name = name
+        self.switch = None
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+
+    def get_channels(self) -> list:
+        """ChannelDescriptors this reactor speaks on."""
+        return []
+
+    def init_peer(self, peer) -> None:
+        """Called before the peer starts (base_reactor.go InitPeer)."""
+
+    def add_peer(self, peer) -> None:
+        """Called once the peer is running."""
+
+    def remove_peer(self, peer, reason) -> None:
+        pass
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        pass
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+# Reserved channel IDs (SURVEY.md §1).
+PEX_CHANNEL = 0x00
+CONSENSUS_STATE_CHANNEL = 0x20
+CONSENSUS_DATA_CHANNEL = 0x21
+CONSENSUS_VOTE_CHANNEL = 0x22
+CONSENSUS_VOTE_SET_BITS_CHANNEL = 0x23
+MEMPOOL_CHANNEL = 0x30
+EVIDENCE_CHANNEL = 0x38
+BLOCKSYNC_CHANNEL = 0x40
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61

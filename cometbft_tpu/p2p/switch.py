@@ -1,0 +1,210 @@
+"""Switch: peer lifecycle + reactor routing (reference: p2p/switch.go, 860 LoC).
+
+Reactors register channel descriptors; inbound/outbound peers get an
+MConnection whose receive callback dispatches to the owning reactor.
+Broadcast fan-outs TrySend to every peer (switch.go:271). Persistent peers
+are redialed with exponential backoff (switch.go:474+).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.transport import MultiplexTransport, UpgradedConn
+
+
+class Peer:
+    """p2p/peer.go peer: MConnection + metadata."""
+
+    def __init__(self, up: UpgradedConn, channel_descs, on_receive, on_error):
+        self.node_info = up.node_info
+        self.id = up.peer_id
+        self.is_outbound = up.outbound
+        self.remote_ip = up.remote_addr.rsplit(":", 1)[0]
+        self._kv: dict = {}
+        self.mconn = MConnection(
+            up.conn,
+            channel_descs,
+            lambda ch, msg: on_receive(self, ch, msg),
+            lambda err: on_error(self, err),
+        )
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    def stop(self) -> None:
+        self.mconn.stop()
+
+    def send(self, chan_id: int, msg_bytes: bytes) -> bool:
+        return self.mconn.send(chan_id, msg_bytes)
+
+    def try_send(self, chan_id: int, msg_bytes: bytes) -> bool:
+        return self.mconn.try_send(chan_id, msg_bytes)
+
+    def set(self, key: str, value) -> None:
+        self._kv[key] = value
+
+    def get(self, key: str):
+        return self._kv.get(key)
+
+    def node_info_json(self) -> dict:
+        return self.node_info.to_json()
+
+
+class Switch:
+    """p2p/switch.go Switch."""
+
+    def __init__(self, node_info: NodeInfo, transport: MultiplexTransport, config=None):
+        self.node_info = node_info
+        self.transport = transport
+        self.config = config
+        self.reactors: dict[str, object] = {}
+        self._chan_to_reactor: dict[int, object] = {}
+        self._channel_descs: list[ChannelDescriptor] = []
+        self._peers: dict[str, Peer] = {}
+        self._mtx = threading.RLock()
+        self._running = False
+        self._persistent_addrs: list[str] = []
+        self._dialing: set[str] = set()
+
+    # -- reactors -------------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor) -> None:
+        """switch.go AddReactor: claims the reactor's channel ids."""
+        for desc in reactor.get_channels():
+            if desc.id in self._chan_to_reactor:
+                raise ValueError(f"channel {desc.id:#x} already registered")
+            self._chan_to_reactor[desc.id] = reactor
+            self._channel_descs.append(desc)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        self.node_info.channels = bytes(sorted(self._chan_to_reactor))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, listen_addr: str = "") -> str:
+        self._running = True
+        for reactor in self.reactors.values():
+            reactor.start()
+        actual = ""
+        if listen_addr:
+            actual = self.transport.listen(listen_addr, self._on_inbound)
+        return actual
+
+    def stop(self) -> None:
+        self._running = False
+        with self._mtx:
+            peers = list(self._peers.values())
+        for p in peers:
+            self.stop_peer_for_error(p, "switch stopping")
+        self.transport.close()
+        for reactor in self.reactors.values():
+            reactor.stop()
+
+    # -- peers ----------------------------------------------------------------
+
+    def peers(self) -> list[Peer]:
+        with self._mtx:
+            return list(self._peers.values())
+
+    def num_peers(self) -> int:
+        with self._mtx:
+            return len(self._peers)
+
+    def get_peer(self, peer_id: str) -> Peer | None:
+        with self._mtx:
+            return self._peers.get(peer_id)
+
+    def _on_inbound(self, result) -> None:
+        if isinstance(result, Exception):
+            return
+        self._add_peer(result)
+
+    def _add_peer(self, up: UpgradedConn) -> None:
+        """switch.go:808 addPeer."""
+        if up.peer_id == self.node_info.node_id:
+            up.conn.close()  # self-connection
+            return
+        with self._mtx:
+            if up.peer_id in self._peers:
+                up.conn.close()
+                return
+        peer = Peer(up, self._channel_descs, self._on_peer_receive, self._on_peer_error)
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        peer.start()
+        with self._mtx:
+            self._peers[peer.id] = peer
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+
+    def dial_peer(self, addr: str) -> Peer | None:
+        """addr format: id@host:port."""
+        expected_id = addr.split("@", 1)[0] if "@" in addr else ""
+        with self._mtx:
+            if addr in self._dialing:
+                return None
+            self._dialing.add(addr)
+        try:
+            up = self.transport.dial(addr, expected_id)
+            self._add_peer(up)
+            return self.get_peer(up.peer_id)
+        finally:
+            with self._mtx:
+                self._dialing.discard(addr)
+
+    def add_persistent_peers(self, addrs: list[str]) -> None:
+        self._persistent_addrs.extend(a for a in addrs if a)
+
+    def dial_persistent_peers(self) -> None:
+        """Exponential-backoff redial loop (switch.go reconnectToPeer)."""
+
+        def redial(addr):
+            backoff = 1.0
+            while self._running:
+                expected_id = addr.split("@", 1)[0] if "@" in addr else ""
+                if expected_id and self.get_peer(expected_id) is not None:
+                    time.sleep(5)
+                    continue
+                try:
+                    self.dial_peer(addr)
+                    backoff = 1.0
+                except Exception:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 60.0)
+
+        for addr in self._persistent_addrs:
+            threading.Thread(target=redial, args=(addr,), daemon=True).start()
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """switch.go StopPeerForError."""
+        with self._mtx:
+            existing = self._peers.pop(peer.id, None)
+        if existing is None:
+            return
+        peer.stop()
+        for reactor in self.reactors.values():
+            reactor.remove_peer(peer, reason)
+
+    # -- routing --------------------------------------------------------------
+
+    def _on_peer_receive(self, peer: Peer, chan_id: int, msg_bytes: bytes) -> None:
+        reactor = self._chan_to_reactor.get(chan_id)
+        if reactor is None:
+            self.stop_peer_for_error(peer, f"unknown channel {chan_id:#x}")
+            return
+        try:
+            reactor.receive(chan_id, peer, msg_bytes)
+        except Exception as e:
+            self.stop_peer_for_error(peer, e)
+
+    def _on_peer_error(self, peer: Peer, err) -> None:
+        self.stop_peer_for_error(peer, err)
+
+    def broadcast(self, chan_id: int, msg_bytes: bytes) -> None:
+        """switch.go:271 Broadcast: TrySend to every peer."""
+        for peer in self.peers():
+            peer.try_send(chan_id, msg_bytes)
