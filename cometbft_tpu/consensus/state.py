@@ -897,9 +897,16 @@ class ConsensusState:
         return vote
 
     def _vote_time(self):
-        """state.go voteTime: now, but strictly after the last block time."""
+        """state.go:2242 voteTime: now, floored strictly after the locked (or
+        proposal) block's own time per the BFT-time spec — NOT last_block_time:
+        flooring on the previous block would let an ahead-of-clock proposer
+        push MedianTime(commit) <= block time and stall next-height proposals."""
         now = cmttime.now()
-        min_time = self.state.last_block_time.add_nanos(1_000_000)
+        min_time = now
+        if self.rs.locked_block is not None:
+            min_time = self.rs.locked_block.header.time.add_nanos(1_000_000)
+        elif self.rs.proposal_block is not None:
+            min_time = self.rs.proposal_block.header.time.add_nanos(1_000_000)
         if now.unix_nanos() > min_time.unix_nanos():
             return now
         return min_time

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import os
 
-from cometbft_tpu.abci import types as abci_types
 from cometbft_tpu.abci.client import LocalClientCreator
 from cometbft_tpu.abci.example.kvstore import KVStoreApplication
 from cometbft_tpu.config import Config
@@ -65,11 +64,9 @@ class Node:
         self.proxy_app = AppConns(client_creator)
         self.proxy_app.start()
 
-        # Handshake: replay stored blocks into the app (node/node.go:210,
-        # consensus/replay.go Handshaker) — see handshake() below.
-        state = self._handshake(state)
-
-        # Event bus + indexers (node/node.go:173-182).
+        # Event bus + indexers are created AND started before the handshake
+        # (node/node.go:173-182 precede :210 doHandshake) so a block applied
+        # during crash-recovery replay is published and indexed.
         self.event_bus = EventBus()
         if config.tx_index.indexer == "kv":
             self.tx_indexer = KVTxIndexer(new_db("tx_index", config.base.db_backend, db_dir))
@@ -82,6 +79,22 @@ class Node:
         self.indexer_service = IndexerService(
             self.tx_indexer, self.block_indexer, self.event_bus
         )
+        self.event_bus.start()
+        self.indexer_service.start()
+
+        # Handshake: full replay.go height-case analysis so consensus state,
+        # block store, and app advance together (node/node.go:210).
+        from cometbft_tpu.consensus.replay import Handshaker
+
+        handshaker = Handshaker(
+            self.state_store,
+            state,
+            self.block_store,
+            genesis_doc,
+            event_bus=self.event_bus,
+            logger=logger,
+        )
+        state = handshaker.handshake(self.proxy_app)
 
         # Mempool + evidence + executor (node/node.go:230-248).
         self.mempool = CListMempool(config.mempool, self.proxy_app.mempool)
@@ -120,85 +133,11 @@ class Node:
         self.rpc_server = None
         self._rpc_env = None
 
-    # -- handshake / replay ---------------------------------------------------
-
-    def _handshake(self, state):
-        """consensus/replay.go:241 Handshake: query app Info, replay stored
-        blocks ahead of the app's last height."""
-        info = self.proxy_app.query.info(abci_types.RequestInfo())
-        app_height = info.last_block_height
-        store_height = self.block_store.height()
-        if app_height == 0 and state.last_block_height == 0:
-            # InitChain (replay.go:280-330).
-            validators = [
-                abci_types.ValidatorUpdate(pub_key=v.pub_key, power=v.power)
-                for v in self.genesis_doc.validators
-            ]
-            res = self.proxy_app.consensus.init_chain(
-                abci_types.RequestInitChain(
-                    time_seconds=self.genesis_doc.genesis_time.seconds,
-                    chain_id=self.genesis_doc.chain_id,
-                    consensus_params=self.genesis_doc.consensus_params,
-                    validators=validators,
-                    app_state_bytes=b"",
-                    initial_height=self.genesis_doc.initial_height,
-                )
-            )
-            if res.app_hash:
-                state.app_hash = res.app_hash
-            if res.validators:
-                from cometbft_tpu.types.validator import Validator
-                from cometbft_tpu.types.validator_set import ValidatorSet
-
-                vals = [
-                    Validator.new(vu.pub_key, vu.power) for vu in res.validators
-                ]
-                state.validators = ValidatorSet(vals)
-                state.next_validators = state.validators.copy_increment_proposer_priority(1)
-            self.state_store.save(state)
-            return state
-        # Replay blocks the app hasn't seen (replay.go:284 ReplayBlocks),
-        # using the validator set stored for each historical height so
-        # BeginBlock's last_commit_info matches what the app saw live.
-        if app_height > state.last_block_height:
-            raise RuntimeError(
-                f"app block height ({app_height}) is higher than core ({state.last_block_height})"
-            )
-        if app_height < state.last_block_height:
-            from cometbft_tpu.state.execution import build_last_commit_info
-
-            for h in range(app_height + 1, store_height + 1):
-                block = self.block_store.load_block(h)
-                if block is None:
-                    break
-                try:
-                    vals_prev = self.state_store.load_validators(h - 1) if h > 1 else None
-                except Exception:
-                    vals_prev = None
-                commit_info = build_last_commit_info(block.last_commit, vals_prev)
-                self.proxy_app.consensus.begin_block(
-                    abci_types.RequestBeginBlock(
-                        hash=block.hash() or b"",
-                        header=block.header,
-                        last_commit_info=commit_info,
-                    )
-                )
-                for tx in block.data.txs:
-                    self.proxy_app.consensus.deliver_tx(
-                        abci_types.RequestDeliverTx(tx=tx)
-                    )
-                self.proxy_app.consensus.end_block(
-                    abci_types.RequestEndBlock(height=h)
-                )
-                self.proxy_app.consensus.commit()
-        return state
-
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> None:
-        """node/node.go:371 OnStart."""
-        self.event_bus.start()
-        self.indexer_service.start()
+        """node/node.go:371 OnStart (event bus/indexer already run from
+        __init__, as in NewNode)."""
         self.consensus_state.start()
         rpc_laddr = self.config.rpc.laddr
         if rpc_laddr:
@@ -235,23 +174,6 @@ class Node:
     @property
     def rpc_port(self) -> int:
         return self.rpc_server.port if self.rpc_server else 0
-
-
-class _NopMempool:
-    def lock(self):
-        pass
-
-    def unlock(self):
-        pass
-
-    def flush_app_conn(self):
-        pass
-
-    def update(self, *a, **k):
-        pass
-
-    def reap_max_bytes_max_gas(self, *a):
-        return []
 
 
 def _parse_laddr(laddr: str) -> tuple[str, int]:

@@ -367,8 +367,13 @@ def routes(env: Environment) -> dict:
     # ---- tx routes ---------------------------------------------------------
 
     def _decode_tx_param(tx) -> bytes:
+        from cometbft_tpu.rpc.jsonrpc.server import QuotedStr
+
         if isinstance(tx, (bytes, bytearray)):
             return bytes(tx)
+        if isinstance(tx, QuotedStr):
+            # URI `tx="k1=v1"`: raw string bytes (http_uri_handler.go).
+            return str(tx).encode()
         if isinstance(tx, str):
             if tx.startswith("0x"):
                 return bytes.fromhex(tx[2:])

@@ -255,11 +255,17 @@ def _error_response(rpc_id, code: int, message: str, data):
     return {"jsonrpc": "2.0", "id": rpc_id, "error": err}
 
 
+class QuotedStr(str):
+    """A URI param that arrived quoted. The reference's URI handler treats a
+    quoted string for a []byte param as the RAW string bytes (not base64, as
+    JSON-POST []byte params are) — rpc/jsonrpc/server/http_uri_handler.go."""
+
+
 def _coerce_uri_param(v: str):
     """GET params arrive as strings; mimic the reference's URI param parsing
     (quoted strings, 0x-hex, bools, numbers)."""
     if v.startswith('"') and v.endswith('"'):
-        return v[1:-1]
+        return QuotedStr(v[1:-1])
     if v in ("true", "false"):
         return v == "true"
     return v

@@ -345,7 +345,22 @@ def _abci_evidence(evidence: list) -> list:
 
 
 def _encode_responses(abci_responses: dict) -> dict:
-    """JSON-able form of the ABCI responses for the state store."""
+    """JSON-able form of the ABCI responses for the state store. Must be
+    COMPLETE enough to re-run updateState from storage alone: the handshake's
+    ran-Commit-but-didn't-save-state replay path (consensus/replay.go:420
+    mock app) rebuilds EndBlock validator/param updates from here."""
+
+    def enc_events(events):
+        return [
+            {
+                "type": e.type,
+                "attributes": [
+                    {"key": a.key, "value": a.value, "index": a.index}
+                    for a in e.attributes
+                ],
+            }
+            for e in events
+        ]
 
     def enc_tx(r):
         return {
@@ -354,22 +369,123 @@ def _encode_responses(abci_responses: dict) -> dict:
             "log": r.log,
             "gas_wanted": r.gas_wanted,
             "gas_used": r.gas_used,
-            "events": [
-                {
-                    "type": e.type,
-                    "attributes": [
-                        {"key": a.key, "value": a.value, "index": a.index}
-                        for a in e.attributes
-                    ],
-                }
-                for e in r.events
-            ],
+            "events": enc_events(r.events),
         }
 
+    from cometbft_tpu.crypto.encoding import pub_key_to_proto
+
+    end = abci_responses["end_block"]
     return {
         "deliver_txs": [enc_tx(r) for r in abci_responses["deliver_txs"]],
         "end_block": {
-            "validator_updates": len(abci_responses["end_block"].validator_updates),
+            "validator_updates": [
+                {
+                    "pub_key": base64.b64encode(pub_key_to_proto(vu.pub_key)).decode(),
+                    "power": vu.power,
+                }
+                for vu in end.validator_updates
+            ],
+            "consensus_param_updates": _enc_param_updates(
+                end.consensus_param_updates
+            ),
         },
         "begin_block": {},
+    }
+
+
+def _enc_param_updates(updates) -> dict | None:
+    """Section-wise JSON of an abci.ConsensusParams-shaped update. The object
+    is PARTIAL by contract (ConsensusParams.update getattr-guards each
+    section), so it can't be run through ConsensusParams.encode()."""
+    if updates is None:
+        return None
+    out = {}
+    block = getattr(updates, "block", None)
+    if block is not None:
+        out["block"] = {"max_bytes": block.max_bytes, "max_gas": block.max_gas}
+    evidence = getattr(updates, "evidence", None)
+    if evidence is not None:
+        out["evidence"] = {
+            "max_age_num_blocks": evidence.max_age_num_blocks,
+            "max_age_duration_ns": evidence.max_age_duration_ns,
+            "max_bytes": evidence.max_bytes,
+        }
+    validator = getattr(updates, "validator", None)
+    if validator is not None:
+        out["validator"] = {"pub_key_types": list(validator.pub_key_types)}
+    version = getattr(updates, "version", None)
+    if version is not None:
+        out["version"] = {"app": version.app}
+    return out
+
+
+def _dec_param_updates(raw: dict | None):
+    if not raw:
+        return None
+    from types import SimpleNamespace
+
+    ns = SimpleNamespace(block=None, evidence=None, validator=None, version=None)
+    if "block" in raw:
+        ns.block = SimpleNamespace(**raw["block"])
+    if "evidence" in raw:
+        ns.evidence = SimpleNamespace(**raw["evidence"])
+    if "validator" in raw:
+        ns.validator = SimpleNamespace(**raw["validator"])
+    if "version" in raw:
+        ns.version = SimpleNamespace(**raw["version"])
+    return ns
+
+
+def decode_responses(raw: dict) -> dict:
+    """Inverse of _encode_responses: rebuild the in-memory ABCI response
+    objects the replay/mock-app path feeds back through updateState."""
+
+    def dec_events(items):
+        return [
+            abci.Event(
+                type=e["type"],
+                attributes=[
+                    abci.EventAttribute(a["key"], a["value"], a["index"])
+                    for a in e["attributes"]
+                ],
+            )
+            for e in items
+        ]
+
+    def dec_tx(d):
+        return abci.ResponseDeliverTx(
+            code=d["code"],
+            data=base64.b64decode(d["data"]),
+            log=d["log"],
+            gas_wanted=d["gas_wanted"],
+            gas_used=d["gas_used"],
+            events=dec_events(d.get("events", [])),
+        )
+
+    from cometbft_tpu.crypto.encoding import pub_key_from_proto
+
+    end = raw.get("end_block") or {}
+    vus = end.get("validator_updates") or []
+    if isinstance(vus, int):
+        # Legacy round-1 records stored only a count — not enough to rebuild
+        # updateState. Degrading to [] would silently drop validator updates
+        # and diverge from committed validators_hash; fail loudly instead.
+        raise RuntimeError(
+            "stored ABCI responses use the legacy summary format and cannot "
+            "be replayed; reset the node or re-sync"
+        )
+    param_updates = _dec_param_updates(end.get("consensus_param_updates"))
+    return {
+        "deliver_txs": [dec_tx(d) for d in raw.get("deliver_txs", [])],
+        "end_block": abci.ResponseEndBlock(
+            validator_updates=[
+                abci.ValidatorUpdate(
+                    pub_key=pub_key_from_proto(base64.b64decode(vu["pub_key"])),
+                    power=vu["power"],
+                )
+                for vu in vus
+            ],
+            consensus_param_updates=param_updates,
+        ),
+        "begin_block": abci.ResponseBeginBlock(),
     }

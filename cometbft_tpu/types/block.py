@@ -111,8 +111,12 @@ class BlockID:
         return self.hash + self.part_set_header.encode()
 
     def encode(self) -> bytes:
+        # part_set_header is gogoproto non-nullable: always marshaled, so a
+        # zero BlockID encodes as b"\x12\x00" (types.pb.go BlockID
+        # MarshalToSizedBuffer emits tag 0x12 unconditionally). This shapes
+        # the height-1 header hash of every chain.
         return wire.field_bytes(1, self.hash) + wire.field_message(
-            2, self.part_set_header.encode(), emit_empty=False
+            2, self.part_set_header.encode(), emit_empty=True
         )
 
     @classmethod

@@ -1,0 +1,202 @@
+"""Handshake replay height-case tests (reference: consensus/replay.go:284
+ReplayBlocks, exercised there by replay_test.go TestHandshakeReplay*).
+
+Simulates the crash windows between the non-atomic persistence steps of
+finalizeCommit: block saved but state not updated, app committed but state
+not saved, app wiped entirely — each must resync state/store/app without
+double-executing any block.
+"""
+
+import pytest
+
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.consensus.replay import Handshaker
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import (
+    BlockID,
+    Commit,
+    GenesisDoc,
+    GenesisValidator,
+    Time,
+    Vote,
+)
+from cometbft_tpu.types.block import PRECOMMIT_TYPE
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import vote_to_commit_sig
+
+CHAIN_ID = "replay-test-chain"
+NUM_BLOCKS = 3
+
+
+def _make_commit(state, block, block_id, pv_by_addr, height):
+    sigs = []
+    for idx, val in enumerate(state.validators.validators):
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=height,
+            round=0,
+            block_id=block_id,
+            timestamp=block.header.time.add_nanos(10**9 * (idx + 1)),
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        signed = pv_by_addr[val.address].sign_vote(CHAIN_ID, vote)
+        sigs.append(vote_to_commit_sig(signed))
+    return Commit(height=height, round=0, block_id=block_id, signatures=sigs)
+
+
+class Chain:
+    """A committed NUM_BLOCKS-high chain whose stores survive 'restarts'."""
+
+    def __init__(self):
+        pvs = [MockPV() for _ in range(4)]
+        self.gen = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=Time(1700000000, 0),
+            validators=[
+                GenesisValidator(
+                    address=pv.address(), pub_key=pv.get_pub_key(), power=10,
+                    name=f"v{i}",
+                )
+                for i, pv in enumerate(pvs)
+            ],
+        )
+        self.gen.validate_and_complete()
+        self.pv_by_addr = {pv.address(): pv for pv in pvs}
+        self.app_db = MemDB()
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(MemDB())
+        state = make_genesis_state(self.gen)
+        self.state_store.save(state)
+        conns = self.fresh_conns()
+        mempool = CListMempool(MempoolConfig(), conns.mempool)
+        executor = BlockExecutor(
+            self.state_store, conns.consensus, mempool, None, self.block_store
+        )
+        last_commit = Commit(height=0, round=0)
+        for h in range(1, NUM_BLOCKS + 1):
+            mempool.check_tx(b"key%d=value%d" % (h, h))
+            block, block_id, seen = self.make_next(state, executor, last_commit)
+            self.block_store.save_block(block, block.make_part_set(), seen)
+            state, _ = executor.apply_block(state, block_id, block)
+            last_commit = seen
+        self.state = state
+        self.last_commit = last_commit
+        self.executor = executor
+        self.mempool = mempool
+
+    def fresh_conns(self):
+        """'Restart' the app process: new app object over the same app DB."""
+        conns = AppConns(local_client_creator(KVStoreApplication(db=self.app_db)))
+        conns.start()
+        return conns
+
+    def wiped_conns(self):
+        """Restart the app with ALL app state lost."""
+        self.app_db = MemDB()
+        return self.fresh_conns()
+
+    def make_next(self, state, executor, last_commit):
+        height = state.last_block_height + 1
+        proposer = state.validators.get_proposer()
+        block = executor.create_proposal_block(
+            height, state, last_commit, proposer.address
+        )
+        if height == 1:
+            block.last_commit = Commit(height=0, round=0)
+        part_set = block.make_part_set()
+        block_id = BlockID(block.hash(), part_set.header())
+        seen = _make_commit(state, block, block_id, self.pv_by_addr, height)
+        return block, block_id, seen
+
+    def handshake(self, conns):
+        state = self.state_store.load()
+        h = Handshaker(self.state_store, state, self.block_store, self.gen)
+        return h.handshake(conns), h
+
+
+def _app_of(conns):
+    return conns.query._app
+
+
+def test_synced_restart_is_noop():
+    c = Chain()
+    conns = c.fresh_conns()
+    state, h = c.handshake(conns)
+    assert state.last_block_height == NUM_BLOCKS
+    assert h.n_blocks == 0
+    assert _app_of(conns).height == NUM_BLOCKS
+
+
+def test_app_wiped_replays_all_blocks():
+    c = Chain()
+    conns = c.wiped_conns()
+    state, h = c.handshake(conns)
+    app = _app_of(conns)
+    assert app.height == NUM_BLOCKS
+    assert app.size == NUM_BLOCKS  # one tx per block, no double-execution
+    assert app.app_hash == c.state.app_hash
+    assert state.last_block_height == NUM_BLOCKS
+    assert h.n_blocks == NUM_BLOCKS
+
+
+def test_crash_after_save_block_before_commit():
+    """store = state+1, app == state: the stored block must be applied via
+    the real app AND advance consensus state (the round-1 bug left state
+    behind, double-executing the block)."""
+    c = Chain()
+    block, block_id, seen = c.make_next(c.state, c.executor, c.last_commit)
+    c.block_store.save_block(block, block.make_part_set(), seen)
+    assert c.block_store.height() == NUM_BLOCKS + 1
+
+    conns = c.fresh_conns()
+    state, h = c.handshake(conns)
+    app = _app_of(conns)
+    assert state.last_block_height == NUM_BLOCKS + 1
+    assert app.height == NUM_BLOCKS + 1
+    assert state.app_hash == app.app_hash
+    assert h.n_blocks == 1
+    # Persisted state advanced too: a second restart is a no-op.
+    conns2 = c.fresh_conns()
+    state2, h2 = c.handshake(conns2)
+    assert state2.last_block_height == NUM_BLOCKS + 1
+    assert h2.n_blocks == 0
+    assert _app_of(conns2).size == NUM_BLOCKS  # block 4 carried no txs
+
+
+def test_crash_after_app_commit_before_state_save():
+    """store = state+1, app == store: the app already committed the block, so
+    it must be replayed against a MOCK conn from stored ABCI responses —
+    re-running it on the real app would double-apply the txs."""
+    c = Chain()
+    pre_state = c.state_store.load()
+    # add a tx so double-execution would be visible in app.size
+    c.mempool.check_tx(b"crash=tx")
+    block, block_id, seen = c.make_next(c.state, c.executor, c.last_commit)
+    c.block_store.save_block(block, block.make_part_set(), seen)
+    new_state, _ = c.executor.apply_block(c.state, block_id, block)
+    # crash before state save: roll the latest-state record back
+    c.state_store.save(pre_state)
+
+    conns = c.fresh_conns()
+    app_size_before = _app_of(conns).size
+    state, h = c.handshake(conns)
+    app = _app_of(conns)
+    assert state.last_block_height == NUM_BLOCKS + 1
+    assert app.height == NUM_BLOCKS + 1
+    assert app.size == app_size_before  # mock replay: no re-execution
+    assert state.app_hash == new_state.app_hash
+    assert h.n_blocks == 1
+
+
+def test_app_ahead_of_store_rejected():
+    c = Chain()
+    conns = c.fresh_conns()
+    _app_of(conns).height = NUM_BLOCKS + 5
+    with pytest.raises(RuntimeError, match="higher than core"):
+        c.handshake(conns)

@@ -106,6 +106,29 @@ class TestHeaderHashGoldenVector:
         assert Header.decode(h.encode()) == h
 
 
+class TestZeroBlockIDWire:
+    """gogoproto non-nullable part_set_header: a zero BlockID marshals as
+    b'\\x12\\x00' (types.pb.go BlockID.MarshalToSizedBuffer emits tag 0x12
+    unconditionally) — this shapes every chain's height-1 header hash."""
+
+    def test_zero_block_id_bytes(self):
+        assert BlockID().encode() == b"\x12\x00"
+
+    def test_zero_block_id_roundtrip(self):
+        assert BlockID.decode(BlockID().encode()) == BlockID()
+
+    def test_height1_header_encodes_zero_last_block_id(self):
+        import dataclasses
+
+        h = dataclasses.replace(
+            TestHeaderHashGoldenVector()._header(), last_block_id=BlockID()
+        )
+        # field 5 must be present with the 2-byte zero BlockID payload
+        assert b"\x2a\x02\x12\x00" in h.encode()
+        assert Header.decode(h.encode()) == h
+        assert h.hash() is not None
+
+
 class TestRoundTrips:
     def test_vote(self):
         bid = BlockID(b"\x12" * 32, PartSetHeader(5, b"\x34" * 32))
