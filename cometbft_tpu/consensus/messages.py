@@ -292,6 +292,8 @@ def encode_wal_message(msg) -> bytes:
         return bytes([_walmod.MSG_VOTE]) + msg.encode()
     if isinstance(msg, TimeoutInfo):
         return bytes([_walmod.MSG_TIMEOUT]) + msg.encode()
+    if isinstance(msg, HasVoteMessage):
+        return bytes([_walmod.MSG_HAS_VOTE]) + msg.encode()
     raise ValueError(f"unknown WAL message {msg!r}")
 
 
@@ -305,4 +307,6 @@ def decode_wal_message(data: bytes):
         return VoteMessage.decode(body)
     if tag == _walmod.MSG_TIMEOUT:
         return TimeoutInfo.decode(body)
+    if tag == _walmod.MSG_HAS_VOTE:
+        return HasVoteMessage.decode(body)
     raise ValueError(f"unknown WAL tag {tag}")

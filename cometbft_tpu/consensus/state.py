@@ -41,6 +41,7 @@ from cometbft_tpu.consensus.messages import (
 )
 from cometbft_tpu.consensus.ticker import TimeoutTicker
 from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.libs import fail
 from cometbft_tpu.types import cmttime, events as ev
 from cometbft_tpu.types.block import (
     PRECOMMIT_TYPE,
@@ -105,6 +106,7 @@ class ConsensusState:
         self.priv_validator = None
         self.priv_validator_pub_key = None
         self.replay_mode = False
+        self.do_wal_catchup = True
 
         # Unbounded: the single consumer also produces (own proposal parts and
         # votes enter this queue from inside the receive routine), so a
@@ -137,6 +139,12 @@ class ConsensusState:
     def start(self) -> None:
         self.wal.start()
         self.ticker.start()
+        # Catch up within the current height from the WAL BEFORE processing
+        # new messages (state.go:318-370): a node that crashed mid-height
+        # replays its own proposals/votes/timeouts so it can't equivocate and
+        # doesn't stall the round. Corrupted WALs get one repair attempt.
+        if self.do_wal_catchup:
+            self._wal_catchup_with_repair()
         # Hand ticker tocks into the unified queue.
         self._tock_pump = threading.Thread(target=self._pump_tocks, daemon=True)
         self._running = True
@@ -144,6 +152,97 @@ class ConsensusState:
         self._thread = threading.Thread(target=self._receive_routine, daemon=True)
         self._thread.start()
         self._schedule_round0()
+
+    def _wal_catchup_with_repair(self) -> None:
+        """state.go:320-370: catchupReplay, with a one-shot corrupted-WAL
+        repair (backup to .CORRUPTED, keep intact prefix, retry)."""
+        from cometbft_tpu.consensus.wal import DataCorruptionError, repair_wal
+
+        repair_attempted = False
+        while True:
+            try:
+                self._catchup_replay(self.rs.height)
+                return
+            except DataCorruptionError as e:
+                if repair_attempted:
+                    raise
+                repair_attempted = True
+                path = getattr(self.wal, "path", None)
+                if path is None:
+                    raise
+                self._log(f"WAL corrupted ({e}); attempting repair")
+                self.wal.stop()
+                corrupted = path + ".CORRUPTED"
+                import shutil
+
+                shutil.copyfile(path, corrupted)
+                repair_wal(corrupted, path)
+                self.wal.reopen()
+                # Re-anchor: if repair emptied the file, start() rewrites the
+                # EndHeightMessage(0) replay anchor (state.go loadWalFile
+                # re-runs OnStart).
+                self.wal.start()
+            except Exception as e:
+                # Non-corruption replay errors: log and start anyway
+                # (state.go:330 "proceeding to start state anyway").
+                self._log(f"error on WAL catchup replay; starting anyway: {e}")
+                return
+
+    def _catchup_replay(self, cs_height: int) -> None:
+        """consensus/replay.go:93 catchupReplay: re-apply every WAL message
+        recorded after the last committed height's EndHeightMessage."""
+        from cometbft_tpu.consensus.wal import DataCorruptionError
+
+        self.replay_mode = True
+        try:
+            if cs_height < self.state.initial_height:
+                raise RuntimeError(
+                    f"cannot replay height {cs_height}, below initial height "
+                    f"{self.state.initial_height}"
+                )
+            end_height = cs_height - 1
+            if cs_height == self.state.initial_height:
+                end_height = 0
+            if not hasattr(self.wal, "catchup_scan"):
+                return  # nil WAL
+            # One pass answers both: messages to replay, and the sanity check
+            # that no #ENDHEIGHT exists for the CURRENT height (that would
+            # mean update_to_state should already have advanced past it).
+            msgs, saw_cs_end = self.wal.catchup_scan(end_height, cs_height)
+            if saw_cs_end:
+                raise RuntimeError(f"wal should not contain #ENDHEIGHT {cs_height}")
+            if msgs is None:
+                raise RuntimeError(
+                    f"cannot replay height {cs_height}: WAL has no #ENDHEIGHT "
+                    f"for {end_height}"
+                )
+            n = 0
+            for tm in msgs:
+                self._read_replay_message(tm)
+                n += 1
+            if n:
+                self._log(f"WAL catchup: replayed {n} messages at height {cs_height}")
+        finally:
+            self.replay_mode = False
+
+    def _read_replay_message(self, tm) -> None:
+        """replay.go:36-90 readReplayMessage: route one TimedWALMessage back
+        through the live handlers (sign attempts hit the double-sign guard
+        and are ignored in replay mode)."""
+        msg = tm.msg
+        if isinstance(msg, EndHeightMessage):
+            return
+        with self._mtx:
+            if isinstance(msg, TimeoutInfo):
+                self._handle_timeout(msg)
+            else:
+                self._handle_msg(msg, "")
+
+    def _log(self, text: str) -> None:
+        if self.logger is not None and hasattr(self.logger, "error"):
+            self.logger.error(text)
+        else:
+            print(f"[{self.name or 'consensus'}] {text}")
 
     def stop(self) -> None:
         self._running = False
@@ -184,6 +283,7 @@ class ConsensusState:
                     elif kind == "internal":
                         # fsync own messages before acting (state.go:774).
                         self.wal.write_sync(payload)
+                        fail.fail()  # kill-point: own msg durable, unprocessed (state.go:787)
                         self._handle_msg(payload, "")
                     else:
                         self.wal.write(payload)
@@ -671,15 +771,19 @@ class ConsensusState:
         if block.hash() != block_id.hash:
             raise RuntimeError("cannot finalize commit; block hash mismatch")
         self.block_exec.validate_block(self.state, block)
+        fail.fail()  # kill-point: before SaveBlock (state.go:1656)
         # Save to block store before the WAL end-height marker.
         if self.block_store.height() < block.header.height:
             seen_commit = precommits.make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
+        fail.fail()  # kill-point: block saved, no #ENDHEIGHT yet (state.go:1670)
         self.wal.write_sync(EndHeightMessage(height))
+        fail.fail()  # kill-point: #ENDHEIGHT durable, state not applied (state.go:1693)
         state_copy = self.state.copy()
         state_copy, retain_height = self.block_exec.apply_block(
             state_copy, BlockID(block.hash(), block_parts.header()), block
         )
+        fail.fail()  # kill-point: after ApplyBlock (state.go:1720)
         if retain_height > 0:
             try:
                 self.block_store.prune_blocks(retain_height)

@@ -29,6 +29,7 @@ MSG_BLOCK_PART = 0x03
 MSG_VOTE = 0x04
 MSG_TIMEOUT = 0x05
 MSG_EVENT_ROUND_STATE = 0x06
+MSG_HAS_VOTE = 0x07
 
 
 class EndHeightMessage:
@@ -105,27 +106,80 @@ class WAL:
                 os.fsync(self._f.fileno())
                 self._f.close()
 
+    def reopen(self) -> None:
+        """Re-open the append handle after an external rewrite (the repair
+        path: state.go loadWalFile after repairWalFile)."""
+        with self._mtx:
+            if self._running:
+                self._f.close()
+            self._f = open(self.path, "ab")
+            self._running = True
+
     # -- reading / replay -----------------------------------------------------
 
+    def has_end_height(self, height: int) -> bool:
+        """Sanity probe: does ANY intact frame carry EndHeightMessage(height)?
+        Tolerant of corruption (wal.go SearchForEndHeight with
+        IgnoreDataCorruptionErrors) — skippable bad frames are skipped, an
+        unskippable tail ends the scan."""
+        for ok, tm in self._scan_frames():
+            if ok and isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height:
+                return True
+        return False
+
     def search_for_end_height(self, height: int):
-        """wal.go SearchForEndHeight: iterator over messages AFTER
-        EndHeightMessage(height), or None if not found."""
-        msgs = []
-        found = False
-        try:
-            for tm in self.iter_messages():
-                if found:
-                    msgs.append(tm)
-                elif (
-                    isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height
-                ):
-                    found = True
-        except DataCorruptionError:
-            if not found:
-                raise
-        if not found:
-            return None
+        """wal.go SearchForEndHeight semantics for catchup replay: the list of
+        messages AFTER the LAST EndHeightMessage(height), or None if the
+        marker is absent."""
+        msgs, _ = self.catchup_scan(height, None)
         return msgs
+
+    def catchup_scan(self, end_height: int, cs_height: int | None):
+        """One pass serving both catchup questions (replay.go:93-120):
+        returns (messages after the LAST EndHeightMessage(end_height) or None
+        if that marker is absent, whether EndHeightMessage(cs_height) was
+        seen). The marker search tolerates corruption in earlier heights; a
+        corrupt frame AFTER the marker (the height being replayed) raises
+        DataCorruptionError so the caller can repair the WAL."""
+        after: list | None = None
+        saw_cs = False
+        for ok, tm in self._scan_frames():
+            if ok and isinstance(tm.msg, EndHeightMessage):
+                if cs_height is not None and tm.msg.height == cs_height:
+                    saw_cs = True
+                if tm.msg.height == end_height:
+                    after = []  # restart collection at the latest marker
+                    continue
+            if after is None:
+                continue  # still searching; corruption here is ignorable
+            if not ok:
+                raise DataCorruptionError(tm)
+            after.append(tm)
+        return after, saw_cs
+
+    def _scan_frames(self):
+        """Yield (True, TimedWALMessage) per intact frame and (False, reason)
+        per skippable corrupt frame (bad CRC with a plausible length — the
+        reader can still advance); stop silently at a truncated/garbage tail
+        (no resync possible without the reference's per-file groups)."""
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    return
+                crc, length = struct.unpack(">II", hdr)
+                if length > MAX_MSG_SIZE_BYTES:
+                    return  # garbage length: cannot resync
+                payload = f.read(length)
+                if len(payload) < length:
+                    return  # truncated tail
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    yield False, "checksums do not match"
+                    continue
+                try:
+                    yield True, _decode_timed(self._decode, payload)
+                except Exception as e:
+                    yield False, f"undecodable payload: {e}"
 
     def iter_messages(self):
         """Decode every frame; raises DataCorruptionError on a bad frame."""
@@ -149,23 +203,54 @@ class WAL:
                 yield _decode_timed(self._decode, payload)
 
 
-def repair_wal(src_path: str, dst_path: str) -> int:
-    """Copy intact frames, drop everything from the first corrupt frame on
-    (consensus/state.go:320-360 corrupted-WAL repair). Returns frames kept."""
-    kept = 0
-    with open(src_path, "rb") as src, open(dst_path, "wb") as dst:
+def _repair_scan(src_path: str):
+    """Yield (ok, frame_bytes, is_end_height) per frame; ok=False for
+    skippable bad frames (bad CRC or undecodable payload with a plausible
+    length). Stops at an unskippable tail (garbage length / truncation)."""
+    with open(src_path, "rb") as src:
         while True:
             hdr = src.read(8)
             if len(hdr) < 8:
-                break
+                return
             crc, length = struct.unpack(">II", hdr)
             if length > MAX_MSG_SIZE_BYTES:
-                break
+                return
             payload = src.read(length)
-            if len(payload) < length or zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                break
-            dst.write(hdr)
-            dst.write(payload)
+            if len(payload) < length:
+                return
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                yield False, hdr + payload, False
+                continue
+            try:
+                tm = _decode_timed(_default_decode, payload)
+            except Exception:
+                # CRC-valid but undecodable (e.g. foreign tag byte): keeping
+                # it would make every repair attempt a no-op — drop it.
+                yield False, hdr + payload, False
+                continue
+            yield True, hdr + payload, isinstance(tm.msg, EndHeightMessage)
+
+
+def repair_wal(src_path: str, dst_path: str) -> int:
+    """Rewrite the WAL keeping a gap-free replayable suffix
+    (consensus/state.go:320-360 corrupted-WAL repair): skippable bad frames
+    BEFORE the last EndHeightMessage are dropped (old heights — replay skips
+    them anyway), and the file is truncated at the first bad frame AFTER the
+    last marker (the torn-write tail: replaying past a gap could replay
+    messages out of order). Returns frames kept."""
+    frames = list(_repair_scan(src_path))
+    last_marker = -1
+    for i, (ok, _, is_end) in enumerate(frames):
+        if ok and is_end:
+            last_marker = i
+    kept = 0
+    with open(dst_path, "wb") as dst:
+        for i, (ok, raw, _) in enumerate(frames):
+            if not ok:
+                if i <= last_marker:
+                    continue  # droppable old-height frame
+                break  # first gap after the marker: stop
+            dst.write(raw)
             kept += 1
     return kept
 

@@ -1,0 +1,120 @@
+"""Crash-recovery kill-point tests (reference: libs/fail + FAIL_TEST_INDEX,
+consensus/replay_test.go TestHandshakeReplay + wal crash tests).
+
+A real node process (sqlite-backed stores, real WAL, FilePV) is started with
+FAIL_TEST_INDEX=N so the N-th fail() call site hard-kills it mid-commit —
+between WAL fsync, SaveBlock, #ENDHEIGHT, ApplyBlock, app Commit, and state
+save. The restarted process must handshake-replay + WAL-catchup back to a
+consistent state and keep committing blocks. An app-hash divergence or a
+double-sign attempt aborts the restart, failing the test.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+RPC_PORT = 26697
+RPC = f"http://127.0.0.1:{RPC_PORT}"
+
+# Spread across the call-site classes: own-msg fsync points fire first (a few
+# per height), then the finalize/apply points. Override with
+# CMTPU_KILLPOINT_INDEXES="0,1,2,..." for a full sweep.
+DEFAULT_INDEXES = (0, 4, 6, 8, 10, 12)
+
+
+def _indexes():
+    env = os.environ.get("CMTPU_KILLPOINT_INDEXES")
+    if env:
+        return tuple(int(x) for x in env.split(","))
+    return DEFAULT_INDEXES
+
+
+def _status_height() -> int | None:
+    try:
+        with urllib.request.urlopen(f"{RPC}/status", timeout=2) as r:
+            d = json.loads(r.read())
+        return int(d["result"]["sync_info"]["latest_block_height"])
+    except Exception:
+        return None
+
+
+def _spawn(home: str, fail_index: int | None):
+    env = dict(os.environ)
+    env["CMTHOME"] = home
+    env.pop("FAIL_TEST_INDEX", None)
+    if fail_index is not None:
+        env["FAIL_TEST_INDEX"] = str(fail_index)
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "cometbft_tpu.cmd",
+            "start",
+            "--rpc-laddr",
+            f"tcp://127.0.0.1:{RPC_PORT}",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+
+
+def _wait_height(target: int, deadline_s: float) -> int:
+    deadline = time.monotonic() + deadline_s
+    h = None
+    while time.monotonic() < deadline:
+        h = _status_height()
+        if h is not None and h >= target:
+            return h
+        time.sleep(0.5)
+    return h if h is not None else -1
+
+
+@pytest.mark.parametrize("fail_index", _indexes())
+def test_killpoint_recovery(tmp_path, fail_index):
+    home = str(tmp_path / "node")
+    env = dict(os.environ, CMTHOME=home)
+    env.pop("FAIL_TEST_INDEX", None)
+    subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu.cmd", "init"],
+        env=env,
+        check=True,
+        stdout=subprocess.DEVNULL,
+    )
+
+    # Phase 1: run until the kill-point fires (os._exit(99)).
+    proc = _spawn(home, fail_index)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        proc.wait(timeout=10)
+        pytest.skip(f"fail index {fail_index} never reached within 60s")
+    assert rc == 99, f"expected kill-point exit 99, got {rc}: {proc.stderr.read()[-800:]}"
+
+    # Phase 2: restart without the kill-point; it must recover and commit.
+    proc = _spawn(home, None)
+    try:
+        h1 = _wait_height(1, 45)
+        assert h1 >= 1, (
+            f"node did not recover after kill at index {fail_index}: "
+            f"{proc.stderr.read(4000) if proc.poll() is not None else 'no height'}"
+        )
+        h2 = _wait_height(h1 + 2, 45)
+        assert h2 >= h1 + 2, f"chain stalled after recovery ({h1} -> {h2})"
+        assert proc.poll() is None, (
+            f"node crashed after restart: {proc.stderr.read(4000)}"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

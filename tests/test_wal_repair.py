@@ -1,0 +1,120 @@
+"""WAL corruption-repair semantics (reference: consensus/wal.go decoder,
+consensus/state.go:320-360 repair loop)."""
+
+import os
+import struct
+import zlib
+
+from cometbft_tpu.consensus.messages import TimeoutInfo
+from cometbft_tpu.consensus.wal import (
+    WAL,
+    DataCorruptionError,
+    EndHeightMessage,
+    repair_wal,
+)
+
+
+def _write_wal(path, heights_and_msgs):
+    wal = WAL(path)
+    for item in heights_and_msgs:
+        wal.write_sync(item)
+    wal.stop()
+
+
+def _frames(path):
+    """Byte ranges of each frame for targeted corruption."""
+    spans = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        _, ln = struct.unpack(">II", data[pos : pos + 8])
+        spans.append((pos, pos + 8 + ln))
+        pos += 8 + ln
+    return data, spans
+
+
+def _corrupt_frame(path, idx):
+    data, spans = _frames(path)
+    start, end = spans[idx]
+    b = bytearray(data)
+    b[end - 1] ^= 0xFF  # flip a payload byte: CRC mismatch, length intact
+    with open(path, "wb") as f:
+        f.write(b)
+
+
+def _truncate_mid_frame(path, idx):
+    data, spans = _frames(path)
+    start, end = spans[idx]
+    with open(path, "wb") as f:
+        f.write(data[: start + 9])  # header + 1 byte of payload
+
+
+def _mk(path):
+    return [
+        EndHeightMessage(0),
+        TimeoutInfo(0.1, 1, 0, 1),
+        EndHeightMessage(1),
+        TimeoutInfo(0.1, 2, 0, 1),
+        TimeoutInfo(0.2, 2, 1, 2),
+    ]
+
+
+def test_catchup_scan_returns_messages_after_last_marker(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path, _mk(path))
+    wal = WAL(path)
+    msgs, saw = wal.catchup_scan(1, 2)
+    assert saw is False
+    assert [m.msg.height for m in msgs] == [2, 2]
+    assert wal.has_end_height(0) and wal.has_end_height(1)
+    assert not wal.has_end_height(2)
+
+
+def test_corruption_after_marker_raises(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path, _mk(path))
+    _corrupt_frame(path, 3)  # first current-height message
+    wal = WAL(path)
+    try:
+        wal.catchup_scan(1, 2)
+        raise AssertionError("expected DataCorruptionError")
+    except DataCorruptionError:
+        pass
+
+
+def test_corruption_before_marker_is_tolerated(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path, _mk(path))
+    _corrupt_frame(path, 1)  # old-height message
+    wal = WAL(path)
+    msgs, _ = wal.catchup_scan(1, 2)
+    assert [m.msg.height for m in msgs] == [2, 2]
+
+
+def test_repair_preserves_marker_and_good_tail_prefix(tmp_path):
+    """A skippable pre-marker bad frame must NOT truncate the marker; a bad
+    post-marker frame truncates from there on."""
+    path = str(tmp_path / "wal")
+    _write_wal(path, _mk(path))
+    _corrupt_frame(path, 1)  # pre-marker: droppable
+    _corrupt_frame(path, 3)  # post-marker: truncate point
+    fixed = str(tmp_path / "wal.fixed")
+    kept = repair_wal(path, fixed)
+    # kept: EndHeight(0), EndHeight(1) — frame1 dropped, frame3 truncates 3+4.
+    assert kept == 2
+    wal = WAL(fixed)
+    msgs, _ = wal.catchup_scan(1, 2)
+    assert msgs == []  # marker intact, gap-free (empty) tail
+
+
+def test_repair_handles_torn_tail(tmp_path):
+    path = str(tmp_path / "wal")
+    _write_wal(path, _mk(path))
+    _truncate_mid_frame(path, 4)
+    fixed = str(tmp_path / "wal.fixed")
+    kept = repair_wal(path, fixed)
+    assert kept == 4
+    wal = WAL(fixed)
+    msgs, _ = wal.catchup_scan(1, 2)
+    assert [m.msg.height for m in msgs] == [2]
