@@ -37,6 +37,15 @@ def worker() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # Env alone has been observed to still init the TPU plugin; pin it.
         jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: the first on-TPU run pays the XLA compile
+    # once; every later run (and the driver's) hits the disk cache.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
     import numpy as np
 
     from cometbft_tpu.ops import ed25519_kernel as ek

@@ -1,0 +1,32 @@
+"""Light client subsystem (reference: light/ — 4,290 LoC Go).
+
+- verifier: pure VerifyAdjacent / VerifyNonAdjacent / VerifyBackwards
+- client:   bisection Client with trusted store + witness cross-check
+- detector: divergence detection + LightClientAttackEvidence
+- provider: Provider interface (mock / http)
+- store:    DB-backed trusted LightBlock store
+- proxy:    verified RPC proxy (`cometbft light` daemon)
+"""
+
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.provider import (
+    ErrLightBlockNotFound,
+    ErrNoResponse,
+    HTTPProvider,
+    MockProvider,
+    Provider,
+)
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.light import verifier
+
+__all__ = [
+    "Client",
+    "TrustOptions",
+    "Provider",
+    "MockProvider",
+    "HTTPProvider",
+    "LightStore",
+    "verifier",
+    "ErrLightBlockNotFound",
+    "ErrNoResponse",
+]

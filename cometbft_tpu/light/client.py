@@ -1,0 +1,257 @@
+"""Light client with skipping (bisection) verification
+(reference: light/client.go).
+
+The client keeps a trusted store of verified LightBlocks. To verify a new
+header it first tries one non-adjacent jump from the latest trusted block —
+if fewer than 1/3 of the trusted validators persist (ErrNewValSetCantBeTrusted),
+it bisects: fetch the midpoint header, verify trusted→pivot, then
+pivot→target (light/client.go:706 verifySkipping). Every hop's commit is
+batch-verified on the device tier. Witness cross-checking (detector.py) runs
+after primary verification."""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from cometbft_tpu.light import verifier
+from cometbft_tpu.light.provider import (
+    ErrLightBlockNotFound,
+    ErrNoResponse,
+    Provider,
+)
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.validation import Fraction
+
+DEFAULT_PRUNING_SIZE = 1000
+DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 10**9
+DEFAULT_MAX_RETRY_ATTEMPTS = 10
+
+
+@dataclass
+class TrustOptions:
+    """light/client.go TrustOptions: root of trust from a social checkpoint."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+    def validate_basic(self) -> None:
+        if self.period_ns <= 0:
+            raise ValueError("negative or zero trusting period")
+        if self.height <= 0:
+            raise ValueError("negative or zero height")
+        if len(self.hash) != 32:
+            raise ValueError(f"expected hash size to be 32 bytes, got {len(self.hash)}")
+
+
+class ErrNoWitnesses(Exception):
+    pass
+
+
+class Client:
+    """light/client.go Client."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: list[Provider],
+        store: LightStore,
+        trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
+        max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+        pruning_size: int = DEFAULT_PRUNING_SIZE,
+        skip_verification: str = "skipping",  # or "sequential"
+        logger=None,
+    ):
+        verifier.validate_trust_level(trust_level)
+        trust_options.validate_basic()
+        self.chain_id = chain_id
+        self.trusting_period_ns = trust_options.period_ns
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = store
+        self.pruning_size = pruning_size
+        self.mode = skip_verification
+        self.logger = logger
+        self._init_trust(trust_options)
+
+    # -- initialization (client.go:266-360) -----------------------------------
+
+    def _init_trust(self, opts: TrustOptions) -> None:
+        existing = self.store.light_block(opts.height)
+        if existing is not None:
+            if existing.hash() != opts.hash:
+                raise ValueError(
+                    f"stored header hash {existing.hash().hex()} does not match "
+                    f"trust option hash {opts.hash.hex()} at height {opts.height}"
+                )
+            return
+        lb = self.primary.light_block(opts.height)
+        if lb.hash() != opts.hash:
+            raise ValueError(
+                f"primary's header hash {lb.hash().hex()} does not match trust "
+                f"option hash {opts.hash.hex()} at height {opts.height}"
+            )
+        lb.validate_basic(self.chain_id)
+        self.store.save_light_block(lb)
+
+    # -- public API -----------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> LightBlock | None:
+        """client.go TrustedLightBlock: from the store only."""
+        if height == 0:
+            h = self.store.last_light_block_height()
+            if h < 0:
+                return None
+            height = h
+        return self.store.light_block(height)
+
+    def latest_trusted(self) -> LightBlock | None:
+        h = self.store.last_light_block_height()
+        return self.store.light_block(h) if h >= 0 else None
+
+    def update(self, now: Time | None = None) -> LightBlock | None:
+        """client.go Update: verify the primary's latest header."""
+        now = now or cmttime.now()
+        latest = self.primary.light_block(0)
+        trusted = self.latest_trusted()
+        if trusted is not None and latest.height <= trusted.height:
+            return None
+        return self.verify_light_block_at_height(latest.height, now, _latest=latest)
+
+    def verify_light_block_at_height(
+        self, height: int, now: Time | None = None, _latest: LightBlock | None = None
+    ) -> LightBlock:
+        """client.go VerifyLightBlockAtHeight: fetch + verify + cross-check."""
+        if height <= 0:
+            raise ValueError("height must be positive")
+        now = now or cmttime.now()
+        existing = self.store.light_block(height)
+        if existing is not None:
+            return existing
+        target = _latest if _latest is not None and _latest.height == height else (
+            self.primary.light_block(height)
+        )
+        target.validate_basic(self.chain_id)
+        self.verify_header(target, now)
+        return target
+
+    def verify_header(self, new_lb: LightBlock, now: Time) -> None:
+        """client.go:525 VerifyHeader (with the provided validator set)."""
+        trusted = self.latest_trusted()
+        if trusted is None:
+            raise RuntimeError("no trusted state to verify from")
+        if new_lb.height > trusted.height:
+            if self.mode == "sequential":
+                trace = self._verify_sequential(trusted, new_lb, now)
+            else:
+                trace = self._verify_skipping(trusted, new_lb, now)
+            for lb in trace:
+                self.store.save_light_block(lb)
+        elif new_lb.height < self.store.first_light_block_height():
+            self._verify_backwards(new_lb)
+            self.store.save_light_block(new_lb)
+        else:
+            # Height within the trusted range but not stored: verify forward
+            # from the closest lower trusted block.
+            base = self.store.light_block_before(new_lb.height)
+            if base is None:
+                raise RuntimeError(f"no trusted block below {new_lb.height}")
+            trace = self._verify_skipping(base, new_lb, now)
+            for lb in trace:
+                self.store.save_light_block(lb)
+        self._detect_divergence(new_lb, now)
+        self.store.prune(self.pruning_size)
+
+    # -- verification strategies ----------------------------------------------
+
+    def _verify_sequential(self, trusted: LightBlock, target: LightBlock, now: Time):
+        """client.go:613 verifySequential: every height in order."""
+        trace = []
+        current = trusted
+        for h in range(trusted.height + 1, target.height + 1):
+            lb = target if h == target.height else self.primary.light_block(h)
+            lb.validate_basic(self.chain_id)
+            verifier.verify_adjacent(
+                current.signed_header,
+                lb.signed_header,
+                lb.validator_set,
+                self.trusting_period_ns,
+                now,
+                self.max_clock_drift_ns,
+            )
+            current = lb
+            trace.append(lb)
+        return trace
+
+    def _verify_skipping(self, trusted: LightBlock, target: LightBlock, now: Time):
+        """client.go:706 verifySkipping: bisection on ErrNewValSetCantBeTrusted."""
+        trace = []
+        current = trusted
+        stack = [target]
+        fetches = 0
+        while stack:
+            candidate = stack[-1]
+            try:
+                verifier.verify(
+                    current.signed_header,
+                    current.validator_set,
+                    candidate.signed_header,
+                    candidate.validator_set,
+                    self.trusting_period_ns,
+                    now,
+                    self.max_clock_drift_ns,
+                    self.trust_level,
+                )
+            except verifier.ErrNewValSetCantBeTrusted:
+                pivot = (current.height + candidate.height) // 2
+                if pivot in (current.height, candidate.height):
+                    raise
+                fetches += 1
+                if fetches > DEFAULT_MAX_RETRY_ATTEMPTS * 4:
+                    raise RuntimeError("bisection: too many pivot fetches")
+                lb = self.primary.light_block(pivot)
+                lb.validate_basic(self.chain_id)
+                stack.append(lb)
+                continue
+            current = candidate
+            stack.pop()
+            trace.append(candidate)
+        return trace
+
+    def _verify_backwards(self, target: LightBlock) -> None:
+        """client.go backwards: hash-chain from the earliest trusted header."""
+        first_h = self.store.first_light_block_height()
+        current = self.store.light_block(first_h)
+        for h in range(first_h - 1, target.height - 1, -1):
+            lb = target if h == target.height else self.primary.light_block(h)
+            lb.validate_basic(self.chain_id)
+            verifier.verify_backwards(lb.header, current.header)
+            current = lb
+
+    # -- witness cross-check (detector.go) ------------------------------------
+
+    def _detect_divergence(self, new_lb: LightBlock, now: Time) -> None:
+        from cometbft_tpu.light.detector import detect_divergence
+
+        if not self.witnesses:
+            return
+        detect_divergence(self, new_lb, now)
+
+    def remove_witness(self, witness: Provider) -> None:
+        self.witnesses = [w for w in self.witnesses if w is not witness]
+
+
+def random_witness_order(n: int) -> list[int]:
+    order = list(range(n))
+    for i in range(n - 1, 0, -1):
+        j = secrets.randbelow(i + 1)
+        order[i], order[j] = order[j], order[i]
+    return order

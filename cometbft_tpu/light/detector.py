@@ -1,0 +1,105 @@
+"""Witness cross-checking + light-client attack evidence
+(reference: light/detector.go).
+
+After the primary's header verifies, every witness is asked for the same
+height. A witness returning a DIFFERENT header for a verified height is
+evidence of an attack on one of the two: the detector builds
+LightClientAttackEvidence against the conflicting chain and reports it to
+both sides, then fails verification so the caller can react."""
+
+from __future__ import annotations
+
+from cometbft_tpu.light import verifier
+from cometbft_tpu.light.provider import ErrLightBlockNotFound, ErrNoResponse
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.evidence import LightClientAttackEvidence
+from cometbft_tpu.types.light_block import LightBlock
+
+
+class ErrConflictingHeaders(Exception):
+    """detector.go errConflictingHeaders."""
+
+    def __init__(self, witness_index: int, block: LightBlock):
+        self.witness_index = witness_index
+        self.block = block
+        super().__init__(
+            f"witness #{witness_index} has a different header at height "
+            f"{block.height}: {block.hash().hex()}"
+        )
+
+
+class ErrLightClientAttack(Exception):
+    pass
+
+
+def detect_divergence(client, new_lb: LightBlock, now: Time) -> None:
+    """detector.go:48 detectDivergence: compare primary header with every
+    witness; on conflict, build + report evidence and raise."""
+    conflicts = []
+    drop = []
+    for i, witness in enumerate(list(client.witnesses)):
+        try:
+            w_lb = witness.light_block(new_lb.height)
+        except (ErrLightBlockNotFound, ErrNoResponse):
+            # Unresponsive/behind witnesses are dropped (detector.go:92-100).
+            drop.append(witness)
+            continue
+        if w_lb.hash() != new_lb.hash():
+            conflicts.append((i, witness, w_lb))
+    for w in drop:
+        client.remove_witness(w)
+    if not conflicts:
+        return
+    for i, witness, w_lb in conflicts:
+        _examine_and_report(client, new_lb, witness, w_lb, now)
+    raise ErrLightClientAttack(
+        f"{len(conflicts)} witness(es) returned conflicting headers at height "
+        f"{new_lb.height}; evidence reported"
+    )
+
+
+def _examine_and_report(client, primary_lb, witness, witness_lb, now: Time) -> None:
+    """detector.go:120-210 compareNewHeaderWithWitness + evidence build: find
+    the common trusted header, attach the conflicting block, and report
+    against both providers."""
+    common = client.store.light_block_before(primary_lb.height)
+    if common is None:
+        common = client.latest_trusted()
+    ev_against_primary = make_attack_evidence(primary_lb, common)
+    ev_against_witness = make_attack_evidence(witness_lb, common)
+    # The witness believes its own chain: send it evidence of the primary's
+    # block, and vice versa (detector.go gatherEvidence).
+    try:
+        witness.report_evidence(ev_against_primary)
+    except Exception:
+        pass
+    try:
+        client.primary.report_evidence(ev_against_witness)
+    except Exception:
+        pass
+    client.remove_witness(witness)
+
+
+def make_attack_evidence(conflicting: LightBlock, common: LightBlock | None):
+    """types/evidence.go LightClientAttackEvidence from a conflicting block.
+    Byzantine validators = signers of the conflicting commit that were in the
+    common (trusted) validator set (types/evidence.go GetByzantineValidators,
+    lunatic case)."""
+    byzantine = []
+    total_power = 0
+    if common is not None:
+        total_power = common.validator_set.total_voting_power()
+        commit = conflicting.signed_header.commit
+        for cs in commit.signatures:
+            if not cs.for_block_flag():
+                continue
+            val = common.validator_set.get_by_address(cs.validator_address)
+            if val is not None:
+                byzantine.append(val)
+    return LightClientAttackEvidence(
+        conflicting_block=conflicting,
+        common_height=common.height if common is not None else conflicting.height,
+        byzantine_validators=byzantine,
+        total_voting_power=total_power,
+        timestamp=conflicting.signed_header.header.time,
+    )

@@ -1,0 +1,124 @@
+"""Light block providers (reference: light/provider/provider.go, mock, http).
+
+A Provider serves LightBlocks for a chain and accepts evidence reports. The
+HTTP provider rides the JSON-RPC client (rpc/client/http.py) against a full
+node's /commit + /validators routes."""
+
+from __future__ import annotations
+
+from cometbft_tpu.types.block import SignedHeader
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.validator_set import ValidatorSet
+
+
+class ErrLightBlockNotFound(Exception):
+    """provider.ErrLightBlockNotFound: requested height unavailable."""
+
+
+class ErrNoResponse(Exception):
+    """provider.ErrNoResponse: provider unreachable/misbehaving."""
+
+
+class Provider:
+    """light/provider/provider.go Provider interface."""
+
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    def light_block(self, height: int) -> LightBlock:
+        """Height 0 means latest. Raises ErrLightBlockNotFound/ErrNoResponse."""
+        raise NotImplementedError
+
+    def report_evidence(self, ev) -> None:
+        raise NotImplementedError
+
+
+class MockProvider(Provider):
+    """light/provider/mock/mock.go: canned LightBlocks by height."""
+
+    def __init__(self, chain_id: str, light_blocks: dict[int, LightBlock]):
+        self._chain_id = chain_id
+        self.light_blocks = dict(light_blocks)
+        self.evidences = []
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            if not self.light_blocks:
+                raise ErrLightBlockNotFound("no blocks")
+            height = max(self.light_blocks)
+        lb = self.light_blocks.get(height)
+        if lb is None:
+            raise ErrLightBlockNotFound(f"no light block at height {height}")
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        self.evidences.append(ev)
+
+
+class HTTPProvider(Provider):
+    """light/provider/http/http.go: LightBlocks from a node's RPC."""
+
+    def __init__(self, chain_id: str, rpc_client):
+        self._chain_id = chain_id
+        self.client = rpc_client
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        h = height if height > 0 else None
+        try:
+            commit_res = self.client.commit(h)
+            actual_h = int(commit_res["signed_header"]["header"]["height"])
+            vals = self._validators_all(actual_h)
+        except (ErrLightBlockNotFound, ErrNoResponse):
+            raise
+        except Exception as e:
+            raise ErrNoResponse(str(e)) from e
+        sh = _signed_header_from_json(commit_res["signed_header"])
+        lb = LightBlock(signed_header=sh, validator_set=vals)
+        lb.validate_basic(self._chain_id)
+        return lb
+
+    def _validators_all(self, height: int) -> ValidatorSet:
+        """Page through /validators (http.go:165)."""
+        from cometbft_tpu.types.validator import Validator
+
+        vals = []
+        page = 1
+        while True:
+            res = self.client.validators(height, page=page, per_page=100)
+            for v in res["validators"]:
+                vals.append(_validator_from_json(v))
+            total = int(res["total"])
+            if len(vals) >= total or not res["validators"]:
+                break
+            page += 1
+        if not vals:
+            raise ErrLightBlockNotFound(f"no validators at height {height}")
+        return ValidatorSet(vals)
+
+    def report_evidence(self, ev) -> None:
+        self.client.broadcast_evidence(ev)
+
+
+def _validator_from_json(v: dict):
+    import base64
+
+    from cometbft_tpu.crypto.encoding import pub_key_from_type_and_bytes
+    from cometbft_tpu.types.validator import Validator
+
+    pk = v["pub_key"]
+    pub = pub_key_from_type_and_bytes(pk["type"], base64.b64decode(pk["value"]))
+    val = Validator.new(pub, int(v["voting_power"]))
+    val.proposer_priority = int(v.get("proposer_priority", 0))
+    return val
+
+
+def _signed_header_from_json(d: dict) -> SignedHeader:
+    from cometbft_tpu.rpc.json_codec import signed_header_from_json
+
+    return signed_header_from_json(d)

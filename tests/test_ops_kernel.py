@@ -1,0 +1,86 @@
+"""Device-kernel correctness: the smallest bucket of the batched ZIP-215
+verifier (ops/ed25519_kernel) against host-signed vectors. One fixed-shape
+compile (~15s on the 1-core CI box) — kept to a single bucket so the suite
+doesn't recompile per test."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.ops import ed25519_kernel as ek
+
+
+@pytest.fixture(scope="module")
+def batch8():
+    pubs, msgs, sigs = [], [], []
+    for i in range(8):
+        priv = ed25519.gen_priv_key_from_secret(b"kernel-test-%d" % i)
+        msg = b"vote-bytes-%d" % i
+        pubs.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    return pubs, msgs, sigs
+
+
+def test_all_valid(batch8):
+    pubs, msgs, sigs = batch8
+    ok, res = ek.batch_verify(pubs, msgs, sigs)
+    assert ok is True and all(res)
+
+
+def test_bad_sig_localized(batch8):
+    pubs, msgs, sigs = batch8
+    sigs = list(sigs)
+    sigs[5] = sigs[5][:20] + bytes([sigs[5][20] ^ 0x40]) + sigs[5][21:]
+    ok, res = ek.batch_verify(pubs, msgs, sigs)
+    assert ok is False
+    assert res[5] is False and sum(res) == 7
+
+
+def test_wrong_message_localized(batch8):
+    pubs, msgs, sigs = batch8
+    msgs = list(msgs)
+    msgs[0] = b"tampered"
+    ok, res = ek.batch_verify(pubs, msgs, sigs)
+    assert ok is False and res[0] is False and sum(res) == 7
+
+
+def test_s_out_of_range_rejected_host_side(batch8):
+    pubs, msgs, sigs = batch8
+    sigs = list(sigs)
+    bad_s = (ek.L + 5).to_bytes(32, "little")
+    sigs[2] = sigs[2][:32] + bad_s
+    ok, res = ek.batch_verify(pubs, msgs, sigs)
+    assert ok is False and res[2] is False
+
+
+def test_stacked_ops_match_reference_scalar_path():
+    """double_stacked / add_precomp agree with the narrow hwcd formulas."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from cometbft_tpu.ops import edwards as ed
+    from cometbft_tpu.ops import field25519 as fe
+
+    # a small batch of random points: decompress pubkeys
+    pubs = [
+        ed25519.gen_priv_key_from_secret(b"p%d" % i).pub_key().bytes()
+        for i in range(4)
+    ]
+    enc = np.stack([np.frombuffer(p, np.uint8) for p in pubs])
+    y = jnp.asarray(fe.fe_from_bytes_le(enc))
+    sign = jnp.asarray((enc[:, 31] >> 7).astype(bool))
+    pt, ok = ed.decompress(y, sign)
+    assert np.asarray(ok).all()
+
+    d1 = ed.point_double(pt)
+    d2 = ed.double_stacked(pt)
+    for a, b in zip(d1, d2):
+        assert np.asarray(fe.fe_eq(a, b)).all()
+
+    s1 = ed.point_add(pt, d1)
+    s2 = ed.add_precomp(pt, ed.to_precomp(d1))
+    for a, b in zip(s1, s2):
+        assert np.asarray(fe.fe_eq(a, b)).all()
