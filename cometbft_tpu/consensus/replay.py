@@ -141,9 +141,13 @@ class Handshaker:
         if store_height == state_height:
             # CometBFT ran Commit and saved state; app may ask for replay.
             if app_height < store_height:
-                self._replay_blocks_through_app(
+                replayed = self._replay_blocks_through_app(
                     state, proxy_app, app_height, store_height
                 )
+                # replay.go:488 assertAppHashEqualsOneFromState: replay does
+                # not mutate state here, so the app must land exactly on the
+                # hash consensus already committed to.
+                _assert_app_hash(replayed, state.app_hash, "state")
             elif app_height == store_height:
                 _assert_app_hash(app_hash, state.app_hash, "state")
             return state
@@ -216,16 +220,22 @@ class Handshaker:
 
     def _replay_blocks_through_app(self, state, proxy_app, from_height, to_height):
         """replay.go:439-490 replayBlocks: raw ABCI execution (no state
-        mutation — historical validator sets come from the state store)."""
+        mutation — historical validator sets come from the state store).
+        Returns the app hash of the last replayed Commit so callers can run
+        the reference's assertAppHashEqualsOneFromState check."""
         first = from_height + 1
         if first == 1:
             first = state.initial_height
+        app_hash = b""
         for h in range(first, to_height + 1):
             block = self.store.load_block(h)
             if block is None:
                 raise RuntimeError(f"block store has no block at height {h}")
-            self._exec_commit_block(proxy_app.consensus, block, h, state.initial_height)
+            app_hash = self._exec_commit_block(
+                proxy_app.consensus, block, h, state.initial_height
+            )
             self.n_blocks += 1
+        return app_hash
 
     def _exec_commit_block(self, conn, block, height, initial_height=1):
         """sm.ExecCommitBlock: BeginBlock/DeliverTx*/EndBlock/Commit with the

@@ -76,6 +76,7 @@ class Client:
         self.max_clock_drift_ns = max_clock_drift_ns
         self.primary = primary
         self.witnesses = list(witnesses)
+        self.had_witnesses = bool(witnesses)
         self.store = store
         self.pruning_size = pruning_size
         self.mode = skip_verification
@@ -239,9 +240,15 @@ class Client:
     # -- witness cross-check (detector.go) ------------------------------------
 
     def _detect_divergence(self, new_lb: LightBlock, now: Time) -> None:
-        from cometbft_tpu.light.detector import detect_divergence
+        from cometbft_tpu.light.detector import ErrNoWitnesses, detect_divergence
 
         if not self.witnesses:
+            if self.had_witnesses:
+                # client.go errNoWitnesses: a client that HAD witnesses but
+                # lost them all must not silently trust the primary forever.
+                raise ErrNoWitnesses(
+                    "all witnesses removed; reset the light client"
+                )
             return
         detect_divergence(self, new_lb, now)
 

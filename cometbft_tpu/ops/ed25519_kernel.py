@@ -58,6 +58,17 @@ def _compiled(n: int):
     return jax.jit(verify_core)
 
 
+def warmup(buckets=(128, 1024, 10240)) -> None:
+    """Precompile the verify program for the given batch buckets ahead of
+    first use (SURVEY §7 hard part 3: the <2 ms latency budget cannot absorb
+    a per-call XLA compile). Shape-only: feeds all-zero operands of each
+    bucket's shape through the jit so the compiled executable (and the
+    persistent compile cache entry) exists before the first real commit."""
+    for b in buckets:
+        operands, _ = pack_batch([b""] * b, [b""] * b, [b""] * b)
+        jax.block_until_ready(_compiled(operands[0].shape[1])(*operands))
+
+
 def _split_enc(enc: np.ndarray):
     """uint8[N,32] point encodings -> (y limbs int32[17,N] — bit 255 dropped
     by the packer — and the sign bit bool[N])."""

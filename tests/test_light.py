@@ -37,11 +37,12 @@ class ChainMaker:
     (rotate=k swaps k of n validators per height, forcing bisection when the
     overlap with a distant trusted set drops below 1/3)."""
 
-    def __init__(self, n_vals=4, heights=20, rotate=0):
+    def __init__(self, n_vals=4, heights=20, rotate=0, pool=None, app_hash=b"\x00" * 32):
         self.pvs = {}
-        pool = [MockPV() for _ in range(n_vals + rotate * heights)]
+        self.pool = pool = pool or [MockPV() for _ in range(n_vals + rotate * heights)]
         for pv in pool:
             self.pvs[pv.address()] = pv
+        self.app_hash = app_hash
         self.blocks: dict[int, LightBlock] = {}
         cur = pool[:n_vals]
         nxt_idx = n_vals
@@ -64,7 +65,7 @@ class ChainMaker:
                 else BlockID(),
                 validators_hash=vals.hash(),
                 next_validators_hash=next_vals.hash(),
-                app_hash=b"\x00" * 32,
+                app_hash=self.app_hash,
                 proposer_address=vals.validators[0].address,
             )
             bid = BlockID(header.hash(), PartSetHeader(1, b"\x02" * 32))
@@ -189,8 +190,10 @@ def test_backwards_verification():
 
 def test_detector_flags_conflicting_witness():
     chain = ChainMaker(heights=10)
-    evil = ChainMaker(heights=10)  # same heights, different chain
-    # graft the honest height-1 block so the witness agrees on the root of trust
+    # A REAL attack: the same validators sign a second, conflicting chain
+    # (lunatic/equivocation), so the witness's chain verifies from the common
+    # trusted header and the divergence is attributable.
+    evil = ChainMaker(heights=10, pool=chain.pool, app_hash=b"\xff" * 32)
     evil_blocks = dict(evil.blocks)
     evil_blocks[1] = chain.blocks[1]
     witness = MockProvider(CHAIN_ID, evil_blocks)
@@ -198,6 +201,42 @@ def test_detector_flags_conflicting_witness():
     with pytest.raises(ErrLightClientAttack):
         c.verify_light_block_at_height(10, NOW)
     assert witness.evidences, "evidence must be reported to the witness"
+
+
+def test_detector_drops_unverifiable_witness():
+    """A witness whose conflicting chain does NOT verify from the common
+    header (different validators entirely) is a bad witness: it is removed
+    without filing bogus evidence against the honest primary
+    (detector.go examineConflictingHeaderAgainstTrace failure path), and
+    verification proceeds on the remaining honest witness."""
+    chain = ChainMaker(heights=10)
+    evil = ChainMaker(heights=10)  # unrelated validators
+    evil_blocks = dict(evil.blocks)
+    evil_blocks[1] = chain.blocks[1]
+    bad = MockProvider(CHAIN_ID, evil_blocks)
+    honest = MockProvider(CHAIN_ID, chain.blocks)
+    c = _client(chain, witnesses=[bad, honest])
+    lb = c.verify_light_block_at_height(10, NOW)
+    assert lb.height == 10
+    assert not bad.evidences, "no evidence may be filed via a bad witness"
+    assert bad not in c.witnesses, "bad witness must be removed"
+    assert honest in c.witnesses
+
+
+def test_detector_no_witnesses_left_errors():
+    """Losing the entire witness set must surface errNoWitnesses (client.go),
+    not silently disable cross-checking."""
+    from cometbft_tpu.light.detector import ErrNoWitnesses
+
+    chain = ChainMaker(heights=10)
+    evil = ChainMaker(heights=10)
+    evil_blocks = dict(evil.blocks)
+    evil_blocks[1] = chain.blocks[1]
+    bad = MockProvider(CHAIN_ID, evil_blocks)
+    c = _client(chain, witnesses=[bad])
+    with pytest.raises(ErrNoWitnesses):
+        c.verify_light_block_at_height(10, NOW)
+    assert not bad.evidences
 
 
 def test_honest_witness_passes():

@@ -1,0 +1,44 @@
+"""Short TPU device probe: timestamps every phase so a hang is attributable.
+
+Run alone (never concurrently with another JAX process — the axon tunnel
+wedges under concurrent clients). Writes phase logs to stdout; the caller
+redirects to a file that survives any timeout kill.
+"""
+
+import os
+import sys
+import time
+
+T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    log(f"start; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
+    import jax
+
+    log(f"jax {jax.__version__} imported")
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    devs = jax.devices()
+    log(f"devices: {devs} (platform={devs[0].platform})")
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), dtype=jnp.float32)
+    log("device array created")
+    y = jax.block_until_ready(x @ x)
+    log(f"matmul done: {float(y[0, 0])}")
+    import numpy as np
+
+    z = np.asarray(y)
+    log(f"transfer back done: {z.shape}")
+    log("PROBE OK")
+
+
+if __name__ == "__main__":
+    main()

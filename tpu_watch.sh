@@ -1,0 +1,34 @@
+#!/bin/bash
+# Poll the axon relay port; the moment it accepts, run ONE phased TPU warmup
+# (bench worker) to compile+cache the kernels and capture a real device
+# number. Logs to tpu_watch.log. Exits after the first successful warmup or
+# after ~11h.
+cd /root/repo
+log() { echo "[watch $(date +%H:%M:%S)] $*" >> tpu_watch.log; }
+log "watcher started"
+for i in $(seq 1 660); do
+  if python - <<'EOF'
+import socket, sys
+s = socket.socket(); s.settimeout(3)
+try:
+    s.connect(("127.0.0.1", 8082)); sys.exit(0)
+except OSError:
+    sys.exit(1)
+EOF
+  then
+    log "relay port OPEN (iteration $i); running warmup"
+    timeout 900 python -u bench.py --worker > tpu_warm.out 2> tpu_warm.err
+    rc=$?
+    log "warmup rc=$rc"
+    tail -20 tpu_warm.err >> tpu_watch.log
+    cat tpu_warm.out >> tpu_watch.log
+    if [ "$rc" = "0" ]; then
+      log "TPU warmup SUCCEEDED — compile cache warm"
+      exit 0
+    fi
+    log "warmup failed; continuing to poll"
+    sleep 300
+  fi
+  sleep 60
+done
+log "watcher expired without a successful warmup"
