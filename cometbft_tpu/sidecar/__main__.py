@@ -1,0 +1,5 @@
+"""`python -m cometbft_tpu.sidecar` — run the verification sidecar server."""
+
+from cometbft_tpu.sidecar.service import main
+
+main()

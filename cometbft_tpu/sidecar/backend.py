@@ -75,20 +75,14 @@ _backend: VerifyBackend | None = None
 _lock = threading.Lock()
 
 
-def _make_backend() -> VerifyBackend:
-    choice = os.environ.get("CMTPU_BACKEND", "auto").lower()
+def device_backend(choice: str = "auto") -> VerifyBackend:
+    """cpu/tpu/auto selection shared by the in-process path and the sidecar
+    server. auto: prefer an accelerator if one is visible; fall back to CPU
+    if the device tier can't initialize rather than failing the first call."""
     if choice == "cpu":
         return CpuBackend()
     if choice == "tpu":
         return TpuBackend()
-    if choice == "grpc":
-        from cometbft_tpu.sidecar.service import GrpcBackend
-
-        return GrpcBackend(os.environ.get("CMTPU_SIDECAR_ADDR", "localhost:26670"))
-    if choice != "auto":
-        raise ValueError(f"unknown CMTPU_BACKEND {choice!r}")
-    # auto: prefer an accelerator if one is visible; fall back to CPU if the
-    # device tier can't initialize rather than failing the first verify call.
     try:
         import jax
 
@@ -97,6 +91,17 @@ def _make_backend() -> VerifyBackend:
     except Exception:
         pass
     return CpuBackend()
+
+
+def _make_backend() -> VerifyBackend:
+    choice = os.environ.get("CMTPU_BACKEND", "auto").lower()
+    if choice == "grpc":
+        from cometbft_tpu.sidecar.service import GrpcBackend
+
+        return GrpcBackend(os.environ.get("CMTPU_SIDECAR_ADDR", "127.0.0.1:26670"))
+    if choice not in ("auto", "cpu", "tpu"):
+        raise ValueError(f"unknown CMTPU_BACKEND {choice!r}")
+    return device_backend(choice)
 
 
 def get_backend() -> VerifyBackend:

@@ -1,0 +1,290 @@
+"""Verification sidecar: one long-lived process owns the TPU and serves
+batch verification + Merkle hashing to any number of node processes.
+
+This is the §7 design stance ("JAX/Pallas behind a gRPC verification
+sidecar", SURVEY.md) realized for this image: grpcio is not available, so
+the transport is the same shape as the reference's ABCI socket protocol
+(abci/client/socket_client.go:529 — length-prefixed protobuf over TCP/unix,
+pipelined requests) carrying gRPC-style unary methods:
+
+    BatchVerify(pubs, msgs, sigs) -> (ok, bitmap)   crypto.BatchVerifier
+    MerkleRoot(leaves)            -> root           crypto/merkle/tree.go:11
+    Ping()                        -> pong           health check
+    Warmup(buckets)               -> ok             precompile batch buckets
+
+Wire format: every frame is a 4-byte big-endian length + protobuf body.
+  Request  { 1: id (uvarint), 2: method (string), 3: payload (bytes) }
+  Response { 1: id (uvarint), 2: ok (bool), 3: error (string), 4: payload }
+  BatchVerifyReq  { 1..3: repeated pubs/msgs/sigs (bytes) }
+  BatchVerifyResp { 1: all_ok (bool), 2: bitmap (bytes, 1 byte per sig) }
+  MerkleReq       { 1: repeated leaves (bytes) }
+  MerkleResp      { 1: root (bytes) }
+  WarmupReq       { 1: repeated buckets (uvarint) }
+
+Running the device behind one process also serializes TPU access — exactly
+the property this host needs (the axon tunnel wedges under concurrent
+clients; see tpu_watch.sh / memory notes).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+
+from cometbft_tpu.sidecar.backend import TpuBackend, VerifyBackend, device_backend
+from cometbft_tpu.wire import proto
+
+DEFAULT_ADDR = "127.0.0.1:26670"
+DEFAULT_BUCKETS = (128, 1024, 10240)
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def write_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def read_frame(sock: socket.socket) -> bytes | None:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return _read_exact(sock, n)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _encode_request(req_id: int, method: str, payload: bytes) -> bytes:
+    return (
+        proto.field_varint(1, req_id, emit_default=True)
+        + proto.field_string(2, method)
+        + proto.field_bytes(3, payload)
+    )
+
+
+def _encode_response(req_id: int, ok: bool, error: str, payload: bytes) -> bytes:
+    return (
+        proto.field_varint(1, req_id, emit_default=True)
+        + proto.field_bool(2, ok)
+        + proto.field_string(3, error)
+        + proto.field_bytes(4, payload)
+    )
+
+
+# -- server -------------------------------------------------------------------
+
+
+class SidecarServer:
+    """The long-lived device owner. Device calls are serialized with a lock
+    (one TPU, one XLA stream); socket handling is one thread per connection,
+    so hosts can pipeline requests like the reference's socket ABCI client."""
+
+    def __init__(self, addr: str = DEFAULT_ADDR, backend: VerifyBackend | None = None):
+        self.addr = addr
+        self.backend = backend if backend is not None else device_backend(
+            os.environ.get("CMTPU_SIDECAR_DEVICE", "auto").lower()
+        )
+        self._device_lock = threading.Lock()
+        host, port = addr.rsplit(":", 1)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                while True:
+                    try:
+                        body = read_frame(sock)
+                    except (OSError, ValueError):
+                        return
+                    if body is None:
+                        return
+                    req_id = 0
+                    try:  # fault isolation per request, incl. malformed bodies
+                        fields = proto.decode_fields(body)
+                        req_id = proto.get_uvarint(fields, 1)
+                        method = proto.get_string(fields, 2)
+                        payload = proto.get_bytes(fields, 3)
+                        out = outer._dispatch(method, payload)
+                        resp = _encode_response(req_id, True, "", out)
+                    except Exception as e:
+                        resp = _encode_response(req_id, False, f"{type(e).__name__}: {e}", b"")
+                    try:
+                        write_frame(sock, resp)
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, int(port)), Handler)
+
+    def _dispatch(self, method: str, payload: bytes) -> bytes:
+        if method == "Ping":
+            return b"pong"
+        if method == "BatchVerify":
+            fields = proto.decode_fields(payload)
+            pubs = proto.get_repeated_bytes(fields, 1)
+            msgs = proto.get_repeated_bytes(fields, 2)
+            sigs = proto.get_repeated_bytes(fields, 3)
+            if not (len(pubs) == len(msgs) == len(sigs)):
+                raise ValueError("pubs/msgs/sigs length mismatch")
+            with self._device_lock:
+                ok, bitmap = self.backend.batch_verify(pubs, msgs, sigs)
+            return proto.field_bool(1, ok) + proto.field_bytes(
+                2, bytes(1 if b else 0 for b in bitmap)
+            )
+        if method == "MerkleRoot":
+            fields = proto.decode_fields(payload)
+            leaves = proto.get_repeated_bytes(fields, 1)
+            with self._device_lock:
+                root = self.backend.merkle_root(leaves)
+            return proto.field_bytes(1, root)
+        if method == "Warmup":
+            fields = proto.decode_fields(payload)
+            buckets = tuple(proto.get_repeated_uvarint(fields, 1)) or DEFAULT_BUCKETS
+            self.warmup(buckets)
+            return b""
+        raise ValueError(f"unknown method {method!r}")
+
+    def warmup(self, buckets=DEFAULT_BUCKETS) -> None:
+        """Precompile the batch-verify buckets so the first real commit does
+        not pay an XLA compile (SURVEY §7 hard part 3, <2 ms budget)."""
+        if isinstance(self.backend, TpuBackend):
+            from cometbft_tpu.ops import ed25519_kernel
+
+            with self._device_lock:
+                ed25519_kernel.warmup(buckets)
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def start(self) -> "SidecarServer":
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- client -------------------------------------------------------------------
+
+
+class GrpcBackend(VerifyBackend):
+    """The `CMTPU_BACKEND=grpc` client: speaks the framed protocol above.
+    Thread-safe (one in-flight request per connection, guarded by a lock);
+    reconnects once on a broken connection. Fails loudly when the sidecar is
+    unreachable — an explicitly configured remote verifier must not silently
+    fall back to a different trust path."""
+
+    name = "grpc"
+
+    def __init__(self, addr: str = DEFAULT_ADDR, timeout_s: float = 300.0):
+        self.addr = addr
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _connect(self) -> socket.socket:
+        host, port = self.addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        with self._lock:
+            self._next_id += 1
+            req = _encode_request(self._next_id, method, payload)
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    write_frame(self._sock, req)
+                    body = read_frame(self._sock)
+                    if body is None:
+                        raise ConnectionError("sidecar closed the connection")
+                    break
+                except (OSError, ConnectionError):
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt:
+                        raise
+        fields = proto.decode_fields(body)
+        if not proto.get_bool(fields, 2):
+            raise RuntimeError(f"sidecar error: {proto.get_string(fields, 3)}")
+        return proto.get_bytes(fields, 4)
+
+    def ping(self) -> bool:
+        return self._call("Ping", b"") == b"pong"
+
+    def batch_verify(self, pubs, msgs, sigs):
+        payload = b"".join(
+            proto.field_bytes(1, p, emit_default=True) for p in pubs
+        ) + b"".join(
+            proto.field_bytes(2, m, emit_default=True) for m in msgs
+        ) + b"".join(
+            proto.field_bytes(3, s, emit_default=True) for s in sigs
+        )
+        out = self._call("BatchVerify", payload)
+        fields = proto.decode_fields(out)
+        bitmap = proto.get_bytes(fields, 2)
+        return proto.get_bool(fields, 1), [bool(b) for b in bitmap[: len(pubs)]]
+
+    def merkle_root(self, leaves):
+        payload = b"".join(
+            proto.field_bytes(1, leaf, emit_default=True) for leaf in leaves
+        )
+        out = self._call("MerkleRoot", payload)
+        return proto.get_bytes(proto.decode_fields(out), 1)
+
+    def warmup(self, buckets=DEFAULT_BUCKETS) -> None:
+        self._call(
+            "Warmup",
+            b"".join(proto.field_varint(1, b, emit_default=True) for b in buckets),
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+def main() -> None:
+    """`python -m cometbft_tpu.sidecar`: serve until killed."""
+    addr = os.environ.get("CMTPU_SIDECAR_ADDR", DEFAULT_ADDR)
+    server = SidecarServer(addr)
+    print(f"sidecar: serving on {addr} (backend={server.backend.name})", flush=True)
+    if os.environ.get("CMTPU_SIDECAR_WARM", "1") == "1":
+        server.warmup()
+        print("sidecar: warmup complete", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -203,3 +203,18 @@ def get_sfixed64(fields: dict, num: int, default: int = 0) -> int:
 
 def get_repeated_bytes(fields: dict, num: int) -> list[bytes]:
     return list(fields.get(num, []))
+
+
+def get_repeated_uvarint(fields: dict, num: int) -> list[int]:
+    """Repeated uvarint field, accepting both unpacked (one varint per tag)
+    and proto3 packed (one length-delimited run of varints) encodings."""
+    out: list[int] = []
+    for v in fields.get(num, []):
+        if isinstance(v, int):
+            out.append(v)
+        else:  # packed: bytes holding consecutive varints
+            pos = 0
+            while pos < len(v):
+                val, pos = decode_uvarint(v, pos)
+                out.append(val)
+    return out
