@@ -45,9 +45,22 @@ class KVStoreApplication(abci.Application):
     """abci/example/kvstore/kvstore.go: tx is "key=value" or raw bytes;
     AppHash = varint(size) in 8 bytes."""
 
-    def __init__(self, db: DB | None = None, retain_blocks: int = 0):
+    def __init__(
+        self,
+        db: DB | None = None,
+        retain_blocks: int = 0,
+        snapshot_interval: int = 0,
+        snapshot_chunk_size: int = 65536,
+    ):
         self.db = db or MemDB()
         self.retain_blocks = retain_blocks
+        # State-sync snapshots (reference: test/e2e/app/app.go:22-60 — the
+        # purpose-built e2e app is the one that snapshots; plain kvstore.go
+        # doesn't). Off unless snapshot_interval > 0.
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_chunk_size = snapshot_chunk_size
+        self._snapshots: dict[tuple, tuple[abci.Snapshot, list[bytes]]] = {}
+        self._restore: tuple[abci.Snapshot, list] | None = None
         self._tx_to_remove: set[bytes] = set()
         st = self.db.get(_STATE_KEY)
         if st:
@@ -124,10 +137,98 @@ class KVStoreApplication(abci.Application):
         self.app_hash = app_hash
         self.height += 1
         self._save_state()
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         resp = abci.ResponseCommit(data=app_hash)
         if self.retain_blocks > 0 and self.height >= self.retain_blocks:
             resp.retain_height = self.height - self.retain_blocks + 1
         return resp
+
+    # -- state-sync snapshots (test/e2e/app/snapshots.go shape) ---------------
+
+    def _snapshot_blob(self) -> bytes:
+        pairs = {}
+        for k, v in self.db.iterator():
+            if k.startswith(_KV_PAIR_PREFIX):
+                pairs[base64.b64encode(k[len(_KV_PAIR_PREFIX):]).decode()] = (
+                    base64.b64encode(v).decode()
+                )
+        return json.dumps(
+            {
+                "height": self.height,
+                "size": self.size,
+                "app_hash": base64.b64encode(self.app_hash).decode(),
+                "pairs": pairs,
+            },
+            sort_keys=True,
+        ).encode()
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+
+        blob = self._snapshot_blob()
+        cs = self.snapshot_chunk_size
+        chunks = [blob[i : i + cs] for i in range(0, len(blob), cs)] or [b""]
+        snap = abci.Snapshot(
+            height=self.height,
+            format=1,
+            chunks=len(chunks),
+            hash=hashlib.sha256(blob).digest(),
+        )
+        self._snapshots[(snap.height, snap.format)] = (snap, chunks)
+
+    def list_snapshots(self, req):
+        return abci.ResponseListSnapshots(
+            snapshots=[s for s, _ in self._snapshots.values()]
+        )
+
+    def load_snapshot_chunk(self, req):
+        entry = self._snapshots.get((req.height, req.format))
+        if entry is None or not (0 <= req.chunk < len(entry[1])):
+            return abci.ResponseLoadSnapshotChunk()
+        return abci.ResponseLoadSnapshotChunk(chunk=entry[1][req.chunk])
+
+    def offer_snapshot(self, req):
+        snap = req.snapshot
+        if snap is None or snap.format != 1 or snap.chunks < 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT
+            )
+        self._restore = (snap, [None] * snap.chunks)
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req):
+        import hashlib
+
+        if self._restore is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_REJECT_SNAPSHOT
+            )
+        snap, chunks = self._restore
+        if not (0 <= req.index < len(chunks)):
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_RETRY)
+        chunks[req.index] = req.chunk
+        if any(c is None for c in chunks):
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
+        blob = b"".join(chunks)
+        if hashlib.sha256(blob).digest() != snap.hash:
+            # Whole snapshot is bad: refetch everything, drop the senders.
+            self._restore = (snap, [None] * len(chunks))
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY_SNAPSHOT,
+                refetch_chunks=list(range(len(chunks))),
+            )
+        d = json.loads(blob)
+        for k, v in d["pairs"].items():
+            self.db.set(
+                _KV_PAIR_PREFIX + base64.b64decode(k), base64.b64decode(v)
+            )
+        self.height = d["height"]
+        self.size = d["size"]
+        self.app_hash = base64.b64decode(d["app_hash"])
+        self._save_state()
+        self._restore = None
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
 
     def query(self, req):
         value = self.db.get(_KV_PAIR_PREFIX + req.data)

@@ -93,6 +93,20 @@ class BlocksyncReactor(Reactor):
     def stop(self) -> None:
         self._running = False
 
+    def switch_to_block_sync(self, state, block_exec=None) -> None:
+        """reactor.go SwitchToBlockSync: statesync finished — start fast-sync
+        from the freshly bootstrapped state (node.go:423-433 boot phasing)."""
+        self.state = state
+        if block_exec is not None:
+            self.block_exec = block_exec
+        self.synced = False
+        with self.pool._mtx:
+            self.pool.height = state.last_block_height + 1
+        was_enabled = self.block_sync_enabled
+        self.block_sync_enabled = True
+        if self._running and not was_enabled:
+            threading.Thread(target=self._pool_routine, daemon=True).start()
+
     # -- peers ----------------------------------------------------------------
 
     def add_peer(self, peer) -> None:

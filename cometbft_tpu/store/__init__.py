@@ -150,6 +150,13 @@ class BlockStore:
                 self._base = height
             self._save_state()
 
+    def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
+        """store/store.go SaveSeenCommit: statesync bootstrap saves the
+        light-client-verified commit for the restored height so consensus
+        (and RPC /commit) can build on it without the block itself."""
+        with self._mtx:
+            self._db.set(_seen_commit_key(height), seen_commit.encode())
+
     def prune_blocks(self, retain_height: int) -> int:
         """store/store.go:268-330: delete blocks below retain_height, keep
         state-relevant commits. Returns number pruned."""

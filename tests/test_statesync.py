@@ -1,0 +1,352 @@
+"""Statesync tests (reference: statesync/syncer_test.go + reactor behavior):
+a fresh node restores an app snapshot over real TCP, verified against
+light-client truth, then catches the chain tip via blocksync."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.light.provider import MockProvider
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from cometbft_tpu.statesync import LightClientStateProvider, StatesyncReactor, Syncer
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import BlockID, Commit, GenesisDoc, GenesisValidator, Time, Vote
+from cometbft_tpu.types.block import PRECOMMIT_TYPE, SignedHeader
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import vote_to_commit_sig
+
+CHAIN_ID = "ssync-chain"
+
+
+def _genesis(pvs):
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+    return gen
+
+
+def _populated_node(pvs, gen, n_blocks, snapshot_interval):
+    """Chain built through the executor so the app takes real snapshots."""
+    state = make_genesis_state(gen)
+    app = KVStoreApplication(
+        snapshot_interval=snapshot_interval, snapshot_chunk_size=64
+    )
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    mempool = CListMempool(make_test_config().mempool, conns.mempool)
+    state_store, block_store = StateStore(MemDB()), BlockStore(MemDB())
+    state_store.save(state)
+    executor = BlockExecutor(state_store, conns.consensus, mempool, None, block_store)
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    last_commit = Commit(height=0, round=0)
+    for h in range(1, n_blocks + 1):
+        mempool.check_tx(b"key%d=val%d" % (h, h))
+        proposer = state.validators.get_proposer()
+        block = executor.create_proposal_block(h, state, last_commit, proposer.address)
+        parts = block.make_part_set()
+        bid = BlockID(block.hash(), parts.header())
+        sigs = []
+        for idx, val in enumerate(state.validators.validators):
+            vote = Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=block.header.time.add_nanos(10**9 * (idx + 1)),
+                validator_address=val.address, validator_index=idx,
+            )
+            sigs.append(
+                vote_to_commit_sig(pv_by_addr[val.address].sign_vote(CHAIN_ID, vote))
+            )
+        seen = Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        block_store.save_block(block, parts, seen)
+        state, _ = executor.apply_block(state, bid, block)
+        last_commit = seen
+    return state, block_store, state_store, conns, app
+
+
+def _light_blocks(block_store, state_store, up_to):
+    """LightBlocks from a populated store (provider food for the fresh node)."""
+    out = {}
+    for h in range(1, up_to + 1):
+        meta = block_store.load_block_meta(h)
+        seen = block_store.load_seen_commit(h)
+        vals = state_store.load_validators(h)
+        out[h] = LightBlock(
+            signed_header=SignedHeader(meta.header, seen), validator_set=vals
+        )
+    return out
+
+
+@pytest.fixture
+def populated():
+    pvs = [MockPV() for _ in range(3)]
+    gen = _genesis(pvs)
+    state, block_store, state_store, conns, app = _populated_node(
+        pvs, gen, n_blocks=10, snapshot_interval=4
+    )
+    return pvs, gen, state, block_store, state_store, conns, app
+
+
+def test_kvstore_snapshots_taken(populated):
+    *_, app = populated
+    keys = {(h, f) for h, f in app._snapshots}
+    assert keys == {(4, 1), (8, 1)}
+    snap, chunks = app._snapshots[(8, 1)]
+    assert snap.chunks == len(chunks) > 1  # chunk_size=64 forces multi-chunk
+
+
+def test_kvstore_snapshot_restore_roundtrip(populated):
+    *_, src = populated
+    import cometbft_tpu.abci.types as abci
+
+    snap, chunks = src._snapshots[(8, 1)]
+    dst = KVStoreApplication()
+    res = dst.offer_snapshot(abci.RequestOfferSnapshot(snapshot=snap))
+    assert res.result == abci.OFFER_SNAPSHOT_ACCEPT
+    for i, c in enumerate(chunks):
+        r = dst.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(index=i, chunk=c))
+        assert r.result == abci.APPLY_CHUNK_ACCEPT
+    assert dst.height == 8 and dst.size == src.size - 2  # 2 txs after h=8
+    assert dst.db.get(b"kvPairKey:key3") == b"val3"
+
+
+def test_statesync_over_tcp(populated):
+    pvs, gen, state_a, bstore_a, sstore_a, conns_a, app_a = populated
+
+    # Serving node A.
+    nk_a = NodeKey()
+    ni_a = NodeInfo(node_id=nk_a.id, network=CHAIN_ID, moniker="A")
+    sw_a = Switch(ni_a, MultiplexTransport(ni_a, nk_a))
+    sw_a.add_reactor("STATESYNC", StatesyncReactor(snapshot_conn=conns_a.snapshot))
+    sw_a.add_reactor(
+        "BLOCKSYNC",
+        BlocksyncReactor(state_a, None, bstore_a, block_sync=False),
+    )
+    addr_a = sw_a.start("127.0.0.1:0")
+
+    # Fresh node C: empty app + stores, light provider fed from A's chain.
+    app_c = KVStoreApplication()
+    conns_c = AppConns(local_client_creator(app_c))
+    conns_c.start()
+    sstore_c, bstore_c = StateStore(MemDB()), BlockStore(MemDB())
+    lbs = _light_blocks(bstore_a, sstore_a, 10)
+    provider = MockProvider(CHAIN_ID, lbs)
+    sp = LightClientStateProvider(
+        CHAIN_ID,
+        provider,
+        [],
+        trust_height=1,
+        trust_hash=lbs[1].hash(),
+        consensus_params=state_a.consensus_params,
+        now=lambda: Time(1700000000 + 3600, 0),
+    )
+    reactor_c = StatesyncReactor()
+    syncer = Syncer(
+        conns_c.snapshot,
+        conns_c.query,
+        sp,
+        reactor_c.request_chunk,
+        chunk_timeout=1.0,
+    )
+    reactor_c.set_syncer(syncer)
+    nk_c = NodeKey()
+    ni_c = NodeInfo(node_id=nk_c.id, network=CHAIN_ID, moniker="C")
+    sw_c = Switch(ni_c, MultiplexTransport(ni_c, nk_c))
+    sw_c.add_reactor("STATESYNC", reactor_c)
+    state_c = make_genesis_state(gen)
+    executor_c = BlockExecutor(
+        sstore_c,
+        conns_c.consensus,
+        CListMempool(make_test_config().mempool, conns_c.mempool),
+        None,
+        bstore_c,
+    )
+    bs_reactor_c = BlocksyncReactor(state_c, executor_c, bstore_c, block_sync=False)
+    sw_c.add_reactor("BLOCKSYNC", bs_reactor_c)
+    sw_c.start("127.0.0.1:0")
+    sw_c.dial_peer(f"{nk_a.id}@{addr_a}")
+    time.sleep(0.3)
+
+    try:
+        # Statesync: restore the height-8 snapshot.
+        new_state, commit = syncer.sync_any(discovery_time=0.5, timeout=30)
+        assert new_state.last_block_height == 8
+        assert app_c.height == 8
+        assert app_c.db.get(b"kvPairKey:key5") == b"val5"
+        assert commit.height == 8
+
+        # Bootstrap stores the way the node boot phase does.
+        sstore_c.bootstrap(new_state)
+        bstore_c.save_seen_commit(8, commit)
+        assert sstore_c.load().last_block_height == 8
+        assert sstore_c.load_validators(8).hash() == state_a.validators.hash()
+
+        # Blocksync from the restored height catches up to tip-1 — the tip
+        # block itself cannot be verified without its successor's LastCommit;
+        # consensus takes over there, exactly the reference's phasing
+        # (node.go:423-433 statesync -> SwitchToBlockSync -> consensus).
+        for peer in sw_c.peers():
+            bs_reactor_c.pool.set_peer_range(peer.id, 1, 10)
+        bs_reactor_c.switch_to_block_sync(new_state)
+        deadline = time.time() + 10
+        while time.time() < deadline and not bs_reactor_c.pool.is_caught_up():
+            time.sleep(0.1)
+        assert app_c.height == 9, f"app stuck at {app_c.height}"
+        assert app_c.db.get(b"kvPairKey:key9") == b"val9"
+        assert bs_reactor_c.pool.is_caught_up()
+        bs_reactor_c.stop()
+    finally:
+        sw_a.stop()
+        sw_c.stop()
+
+
+class _StoreProvider(MockProvider):
+    """Light provider reading a LIVE node's stores (heights keep growing)."""
+
+    def __init__(self, chain_id, block_store, state_store):
+        super().__init__(chain_id, {})
+        self._bs = block_store
+        self._ss = state_store
+
+    def light_block(self, height):
+        if height == 0:
+            height = self._bs.height()
+        meta = self._bs.load_block_meta(height)
+        seen = self._bs.load_seen_commit(height)
+        if meta is None or seen is None:
+            from cometbft_tpu.light.provider import ErrLightBlockNotFound
+
+            raise ErrLightBlockNotFound(str(height))
+        return LightBlock(
+            signed_header=SignedHeader(meta.header, seen),
+            validator_set=self._ss.load_validators(height),
+        )
+
+
+def test_fresh_node_joins_live_net_via_statesync():
+    """VERDICT r2 #3 done-criterion: a fresh node joins a live 3-validator
+    TCP net from a snapshot, then keeps committing via consensus."""
+    from cometbft_tpu.consensus.reactor import ConsensusReactor
+    from cometbft_tpu.consensus.state import ConsensusState
+    from cometbft_tpu.types.cmttime import now as time_now
+
+    pvs = [MockPV() for _ in range(3)]
+    gen = _genesis(pvs)
+    cfg = make_test_config()
+
+    def make_validator(pv, name):
+        state = make_genesis_state(gen)
+        app = KVStoreApplication(snapshot_interval=2, snapshot_chunk_size=256)
+        conns = AppConns(local_client_creator(app))
+        conns.start()
+        mempool = CListMempool(cfg.mempool, conns.mempool)
+        sstore, bstore = StateStore(MemDB()), BlockStore(MemDB())
+        sstore.save(state)
+        executor = BlockExecutor(sstore, conns.consensus, mempool, None, bstore)
+        cs = ConsensusState(cfg.consensus, state, executor, bstore, mempool, name=name)
+        cs.set_priv_validator(pv)
+        nk = NodeKey()
+        ni = NodeInfo(node_id=nk.id, network=CHAIN_ID, moniker=name)
+        sw = Switch(ni, MultiplexTransport(ni, nk))
+        sw.add_reactor("CONSENSUS", ConsensusReactor(cs, gossip_sleep=0.02))
+        sw.add_reactor("STATESYNC", StatesyncReactor(snapshot_conn=conns.snapshot))
+        sw.add_reactor("BLOCKSYNC", BlocksyncReactor(cs.state, None, bstore, block_sync=False))
+        return cs, sw, nk, sstore, bstore
+
+    vals = [make_validator(pv, f"v{i}") for i, pv in enumerate(pvs)]
+    addrs = []
+    try:
+        for cs, sw, nk, *_ in vals:
+            addrs.append(f"{nk.id}@{sw.start('127.0.0.1:0')}")
+        for i, (cs, sw, *_) in enumerate(vals):
+            for j, a in enumerate(addrs):
+                if j > i:
+                    sw.dial_peer(a)
+        time.sleep(0.2)
+        for cs, *_ in vals:
+            cs.start()
+        cs0, sw0, nk0, sstore0, bstore0 = vals[0]
+        assert cs0.wait_for_height(5, timeout=60), f"net stuck at {cs0.rs.height}"
+
+        # Fresh node C joins: statesync from the newest snapshot.
+        app_c = KVStoreApplication()
+        conns_c = AppConns(local_client_creator(app_c))
+        conns_c.start()
+        sstore_c, bstore_c = StateStore(MemDB()), BlockStore(MemDB())
+        state_c = make_genesis_state(gen)
+        sstore_c.save(state_c)
+        mempool_c = CListMempool(cfg.mempool, conns_c.mempool)
+        executor_c = BlockExecutor(sstore_c, conns_c.consensus, mempool_c, None, bstore_c)
+        cs_c = ConsensusState(
+            cfg.consensus, state_c, executor_c, bstore_c, mempool_c, name="C"
+        )
+        lb1 = _StoreProvider(CHAIN_ID, bstore0, sstore0).light_block(1)
+        sp = LightClientStateProvider(
+            CHAIN_ID,
+            _StoreProvider(CHAIN_ID, bstore0, sstore0),
+            [],
+            trust_height=1,
+            trust_hash=lb1.hash(),
+            trust_period_ns=10 * 365 * 24 * 3600 * 10**9,  # genesis uses a
+            # fixed past timestamp while live blocks use the real clock
+            consensus_params=state_c.consensus_params,
+            now=time_now,
+        )
+        reactor_c = StatesyncReactor()
+        syncer = Syncer(
+            conns_c.snapshot, conns_c.query, sp, reactor_c.request_chunk,
+            chunk_timeout=1.0,
+        )
+        reactor_c.set_syncer(syncer)
+        nk_c = NodeKey()
+        ni_c = NodeInfo(node_id=nk_c.id, network=CHAIN_ID, moniker="C")
+        sw_c = Switch(ni_c, MultiplexTransport(ni_c, nk_c))
+        sw_c.add_reactor("CONSENSUS", ConsensusReactor(cs_c, gossip_sleep=0.02))
+        sw_c.add_reactor("STATESYNC", reactor_c)
+        bs_c = BlocksyncReactor(state_c, executor_c, bstore_c, block_sync=False)
+        sw_c.add_reactor("BLOCKSYNC", bs_c)
+        sw_c.start("127.0.0.1:0")
+        for a in addrs:
+            sw_c.dial_peer(a)
+        time.sleep(0.3)
+
+        new_state, commit = syncer.sync_any(discovery_time=0.5, timeout=60)
+        snap_h = new_state.last_block_height
+        assert snap_h >= 2 and app_c.height == snap_h
+        sstore_c.bootstrap(new_state)
+        bstore_c.save_seen_commit(snap_h, commit)
+
+        # Blocksync to (near) the tip, then consensus keeps committing.
+        bs_c.switch_to_block_sync(new_state)
+        deadline = time.time() + 30
+        while time.time() < deadline and not bs_c.pool.is_caught_up():
+            time.sleep(0.1)
+        bs_c.stop()
+        cs_c.update_to_state(bs_c.state)
+        cs_c.start()
+        target = bs_c.state.last_block_height + 3
+        assert cs_c.wait_for_height(target, timeout=60), (
+            f"joined node stuck at {cs_c.rs.height} (target {target})"
+        )
+        assert app_c.height >= target - 1
+        cs_c.stop()
+        sw_c.stop()
+    finally:
+        for cs, sw, *_ in vals:
+            cs.stop()
+            sw.stop()
