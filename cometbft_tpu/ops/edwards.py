@@ -1,12 +1,22 @@
 """Batched edwards25519 point operations for TPU.
 
 Points are extended homogeneous coordinates (X, Y, Z, T), each an
-int32[16, N] field element (see field25519). On edwards25519, a = -1 is a
+int32[17, N] field element (see field25519). On edwards25519, a = -1 is a
 square mod p and d is not, so the hwcd-3 addition formula is COMPLETE: one
 branch-free formula covers doubling, identity, and small-order inputs —
 exactly what SPMD lockstep over a signature batch needs (the reference's
 curve25519-voi backend branches per point class instead;
 crypto/ed25519/ed25519.go:27-29).
+
+Double-scalar multiplication [s]B + [k]A uses SIGNED 4-bit fixed windows
+(64 digits in [-8, 8]): 4 doublings + 2 precomputed-table additions per
+window instead of the 1 doubling + 1 addition per BIT of a Shamir ladder —
+252 doublings + 128 adds total vs 253 + 253. The per-lane table for A is
+built once per batch (4 doublings + 3 additions); the table for the fixed
+base B is a compile-time constant (the analog of curve25519-voi's fixed-base
+precomputation that the reference's single-verify path leans on). Negated
+digits cost one conditional precomp negation — on Edwards that is a
+coordinate swap, which is why signed windows halve the table size for free.
 """
 
 from __future__ import annotations
@@ -116,7 +126,7 @@ def point_is_identity(p):
 
 
 def point_compress(p) -> jnp.ndarray:
-    """Canonical 255-bit y with x-parity sign bit, as limbs [16, N] plus the
+    """Canonical 255-bit y with x-parity sign bit, as limbs [17, N] plus the
     sign bool[N] (serialization handled host-side)."""
     x, y, z, _ = p
     zinv = fe.fe_invert(z)
@@ -151,23 +161,11 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     return (x, y, jnp.broadcast_to(ONE_FE, y.shape), fe.fe_mul(x, y)), ok
 
 
-# -- stacked (lane-concatenated) group ops -----------------------------------
+# -- precomputed ("cached") point form ---------------------------------------
 #
-# The MXU/VPU want FEW, WIDE ops: each hwcd stage's 4 independent field muls
-# are concatenated along the batch axis into ONE [17, 4N] fe_mul, so a ladder
-# step is 4 wide muls instead of 17 narrow ones — 4x fewer dispatches/HLO ops
-# (faster XLA compile) and 4x wider matmul N for MXU tiling. The addend comes
-# from a table kept in precomputed (y-x, y+x, 2d*t, z) form, the standard
-# "cached point" trick, so its 2d scaling costs nothing inside the loop.
-
-
-def _mul4(xs, ys):
-    """Four independent fe_mul as one wide one. xs/ys: 4-tuples of [17, N]."""
-    n = xs[0].shape[1]
-    x = jnp.concatenate(xs, axis=1)
-    y = jnp.concatenate(ys, axis=1)
-    z = fe.fe_mul(x, y)
-    return (z[:, :n], z[:, n : 2 * n], z[:, 2 * n : 3 * n], z[:, 3 * n :])
+# Table entries live in (Y-X, Y+X, 2d*T, Z) form so the 2d scaling is paid
+# once at table-build time; adding a cached point then costs 8 field muls
+# (7 if Z == 1, not exploited — completeness over micro-ops).
 
 
 def to_precomp(p):
@@ -185,78 +183,151 @@ def precomp_select(mask, p, q):
     return tuple(fe.fe_select(mask, a, b) for a, b in zip(p, q))
 
 
+def precomp_neg(q_pre):
+    """-(Y-X, Y+X, 2dT, Z) = (Y+X, Y-X, -2dT, Z): a swap plus one negation."""
+    ymx, ypx, td2, z = q_pre
+    return (ypx, ymx, fe.fe_neg(td2), z)
+
+
 def add_precomp(p, q_pre):
-    """Complete addition against a precomputed point: 2 wide muls."""
+    """Complete addition against a precomputed point: 8 field muls."""
     x1, y1, z1, t1 = p
     ymx, ypx, td2, z2 = q_pre
-    a, b, c, zz = _mul4(
-        (fe.fe_sub(y1, x1), fe.fe_add(y1, x1), t1, z1), (ymx, ypx, td2, z2)
-    )
+    a = fe.fe_mul(fe.fe_sub(y1, x1), ymx)
+    b = fe.fe_mul(fe.fe_add(y1, x1), ypx)
+    c = fe.fe_mul(t1, td2)
+    zz = fe.fe_mul(z1, z2)
     d = fe.fe_add(zz, zz)
     e = fe.fe_sub(b, a)
     f = fe.fe_sub(d, c)
     g = fe.fe_add(d, c)
     h = fe.fe_add(b, a)
-    return _mul4((e, g, f, e), (f, h, g, h))
+    return (fe.fe_mul(e, f), fe.fe_mul(g, h), fe.fe_mul(f, g), fe.fe_mul(e, h))
 
 
-def double_stacked(p):
-    """dbl-2008-hwcd as 2 wide muls (one a wide square)."""
-    x1, y1, z1, _ = p
-    s = jnp.concatenate((x1, y1, z1, fe.fe_add(x1, y1)), axis=1)
-    sq = fe.fe_sq(s)
-    n = x1.shape[1]
-    a, b, zz, s4 = (
-        sq[:, :n],
-        sq[:, n : 2 * n],
-        sq[:, 2 * n : 3 * n],
-        sq[:, 3 * n :],
-    )
-    c = fe.fe_add(zz, zz)
-    e = fe.fe_sub(fe.fe_sub(s4, a), b)
-    g = fe.fe_sub(b, a)
-    f = fe.fe_sub(g, c)
-    h = fe.fe_neg(fe.fe_add(a, b))
-    return _mul4((e, g, f, e), (f, h, g, h))
+# -- signed-window double-scalar multiplication ------------------------------
+
+WINDOW_BITS = 4
+DIGITS = 64  # ceil(253 / 4) windows cover scalars < L < 2^253 (+ carry room)
 
 
-# -- double-scalar multiplication -------------------------------------------
+def build_table_pre(p) -> jnp.ndarray:
+    """Per-lane window table [0..8]P in precomp form as ONE int32[9, 4, 17, N]
+    array (axis 1 = ymx/ypx/2dT/Z). Built by a rolled chain of additions so
+    the table costs a single compiled add_precomp body, not 7 inlined point
+    ops (compile-size control: every planar field mul is ~1.5k HLO ops)."""
+    n = p[0].shape[1]
+    pp = to_precomp(p)
+    tbl = jnp.zeros((9, 4, fe.LIMBS, n), jnp.int32)
+    tbl = tbl.at[0].set(jnp.stack(precomp_identity(n)))
+    tbl = tbl.at[1].set(jnp.stack(pp))
 
-SCALAR_BITS = 253  # scalars are < L < 2^253
+    def body(i, carry):
+        tbl, cur = carry
+        nxt = add_precomp(cur, pp)
+        tbl = tbl.at[i].set(jnp.stack(to_precomp(nxt)))
+        return tbl, nxt
+
+    tbl, _ = lax.fori_loop(2, 9, body, (tbl, p))
+    return tbl
 
 
-def shamir_double_base_mult(s_bits: jnp.ndarray, k_bits: jnp.ndarray, a_point):
-    """[s]B + [k]A batched: interleaved (Shamir) MSB-first double-and-add over
-    the precomputed table {identity, B, A, B+A}, one complete add per bit —
-    the batched analog of the reference's double-scalar verification equation
-    (crypto/ed25519/ed25519.go:168-175). 4 wide [17,4N] muls per bit.
+def _host_table_b() -> jnp.ndarray:
+    """Constant table [0..8]B in precomp form: int32[9, 4, 17, 1], computed
+    with host integer math at import (the fixed-base precomputation — B is a
+    compile-time constant, so [s]B rides the same select/add path as [k]A
+    with a broadcastable table)."""
 
-    s_bits/k_bits: int32[253, N] (bit i = coefficient of 2^i).
-    """
-    n = s_bits.shape[1]
-    ident = identity(n)
-    b = base_point(n)
-    id_pre = precomp_identity(n)
-    b_pre = to_precomp(b)
-    a_pre = to_precomp(a_point)
-    ba_pre = to_precomp(point_add(b, a_point))
+    def add_int(P1, P2):
+        x1, y1 = P1
+        x2, y2 = P2
+        num = _D * x1 * x2 % _P * y1 % _P * y2 % _P
+        x3 = (x1 * y2 + x2 * y1) % _P * pow(1 + num, _P - 2, _P) % _P
+        y3 = (y1 * y2 + x1 * x2) % _P * pow(1 - num + _P, _P - 2, _P) % _P
+        return (x3, y3)
 
-    def body(i, acc):
-        idx = SCALAR_BITS - 1 - i
-        bs = s_bits[idx] == 1
-        bk = k_bits[idx] == 1
-        acc = double_stacked(acc)
-        addend = precomp_select(
-            bs & bk,
-            ba_pre,
-            precomp_select(bk, a_pre, precomp_select(bs, b_pre, id_pre)),
+    rows = [
+        np.stack(
+            [
+                fe.int_to_limbs(1),
+                fe.int_to_limbs(1),
+                fe.int_to_limbs(0),
+                fe.int_to_limbs(1),
+            ]
         )
-        return add_precomp(acc, addend)
+    ]
+    cur = (_BX, _BY)
+    for _ in range(8):
+        x, y = cur
+        rows.append(
+            np.stack(
+                [
+                    fe.int_to_limbs((y - x) % _P),
+                    fe.int_to_limbs((y + x) % _P),
+                    fe.int_to_limbs(x * y % _P * fe.TWO_D_INT % _P),
+                    fe.int_to_limbs(1),
+                ]
+            )
+        )
+        cur = add_int(cur, (_BX, _BY))
+    return jnp.asarray(np.stack(rows)[:, :, :, None])  # [9, 4, 17, 1]
 
-    return lax.fori_loop(0, SCALAR_BITS, body, ident)
+
+TABLE_B_PRE = _host_table_b()
 
 
-def scalars_to_bits(scalars: np.ndarray) -> np.ndarray:
-    """uint8[N, 32] little-endian scalars -> int32[253, N] bit planes (host)."""
-    bits = np.unpackbits(scalars, axis=1, bitorder="little")  # [N, 256]
-    return np.ascontiguousarray(bits[:, :SCALAR_BITS].T).astype(np.int32)
+def select_precomp_signed(table: jnp.ndarray, digits: jnp.ndarray):
+    """Per-lane signed table lookup: digits int32[N] in [-8, 8] -> precomp
+    point table[|d|], negated when d < 0. Binary-cascade selects over the
+    stacked table (no gather: TPU per-lane gathers lower to far slower code
+    than a 4-level vector select tree). table: [9, 4, 17, N] or [9, 4, 17, 1]
+    (constant B table, broadcast over lanes)."""
+    idx = jnp.abs(digits)
+    m = lambda bit: ((idx & bit) == bit)[None, None, None, :]
+    u = table[:8]
+    s = jnp.where(m(1), u[1::2], u[0::2])          # [4,4,17,N], groups by bits 3..2
+    s = jnp.where(m(2)[0], s[1::2], s[0::2])       # [2,4,17,N], groups by bit 3
+    s = jnp.where(m(4)[0, 0], s[1], s[0])          # [4, 17, N]
+    s = jnp.where(m(8)[0, 0], table[8], s)         # |d| == 8
+    pt = (s[0], s[1], s[2], s[3])
+    return precomp_select(digits < 0, precomp_neg(pt), pt)
+
+
+def windowed_double_base_mult(s_digits: jnp.ndarray, k_digits: jnp.ndarray, a_point):
+    """[s]B + [k]A batched over lanes: signed 4-bit fixed windows, MSB-first.
+    s_digits/k_digits: int32[64, N] signed digits (weight 16^w at row w, from
+    scalars_to_digits). The batched analog of the reference's double-scalar
+    verification equation (crypto/ed25519/ed25519.go:168-175), restructured
+    for SPMD: per window, 4 accumulator doublings + one add from the
+    per-lane [1..8]A table + one add from the constant [1..8]B table."""
+    n = s_digits.shape[1]
+    table_a = build_table_pre(a_point)
+
+    def body(w, acc):
+        row = DIGITS - 1 - w
+        acc = lax.fori_loop(0, WINDOW_BITS, lambda _, a: point_double(a), acc)
+        acc = add_precomp(acc, select_precomp_signed(table_a, k_digits[row]))
+        acc = add_precomp(acc, select_precomp_signed(TABLE_B_PRE, s_digits[row]))
+        return acc
+
+    return lax.fori_loop(0, DIGITS, body, identity(n))
+
+
+def scalars_to_digits(scalars: np.ndarray) -> np.ndarray:
+    """uint8[N, 32] little-endian scalars (< 2^253) -> int32[64, N] signed
+    radix-16 digits in [-8, 8] (host). Row w has weight 16^w; digit 8 only
+    ever appears with positive sign (from the -8 recode's carry)."""
+    n = scalars.shape[0]
+    nib = np.zeros((n, DIGITS), np.int32)
+    nib[:, 0::2] = scalars & 15
+    nib[:, 1::2] = scalars >> 4
+    digits = np.zeros((n, DIGITS), np.int32)
+    carry = np.zeros(n, np.int32)
+    for w in range(DIGITS):
+        d = nib[:, w] + carry
+        over = d > 8
+        digits[:, w] = np.where(over, d - 16, d)
+        carry = over.astype(np.int32)
+    # scalars < 2^253: top nibble <= 1, so the final carry is absorbed.
+    assert not carry.any(), "scalar exceeded 2^253 in signed-digit recode"
+    return np.ascontiguousarray(digits.T)

@@ -6,22 +6,35 @@ wrap-around factor is just 19 (2^255 = 19 mod p) — no oversized fold
 constants. Limbs carry a LOOSE invariant: every public op returns limbs in
 [0, 2^15 + 95], which keeps all intermediates exact:
 
-  - products:       (2^15+95)^2           < 2^30.1  (int32, no overflow)
-  - split halves:   lo < 2^15, hi < 2^15.1 (exact in float32)
-  - column sums:    <= 34 * 2^15.1 < 2^20.2 (exact in float32 accumulation)
-  - 19-fold:        < 2^24.5              (int32)
+  - products:         (2^15+95)^2          < 2^30.2  (int32, no overflow)
+  - split halves:     lo < 2^15, hi < 2^15.2
+  - column sums:      <= 17 * 2^15.2       < 2^19.3  (int32)
+  - 19-fold:          < 2^23.7             (int32)
 
-Carries are PARALLEL (shift-mask-roll over the limb axis), not sequential
-chains: two passes after a multiply, one after add/sub — the shape XLA fuses
-into a handful of vector ops. This is the TPU-native replacement for
-curve25519-voi's assembly field element (reference backend of
-crypto/ed25519/ed25519.go:27-29).
+The multiply has TWO lowerings, chosen per backend at trace time:
+
+  - PLANAR (TPU): all 289 limb products and their column sums are emitted as
+    individual [N]-wide VPU ops (one big XLA fusion), not as a [17,17,N]
+    tensor + accumulation matmul. On TPU v5e the planar form measured ~2.5x
+    faster than the matmul form (the f32 HIGHEST accumulation matmul runs as
+    a 6-pass bf16 emulation, and the [17,17,N] intermediates cost HBM
+    round-trips), and TPU compile time scales linearly with chain length.
+  - COMPACT (CPU): the [17,17,N] product tensor + one-hot f32 accumulation
+    matmul (~15 HLO ops per multiply). XLA:CPU's compile time is quadratic
+    in elementwise-fusion size — a straight-line chain of 8 planar muls
+    takes minutes to compile on CPU — so the CPU backend (tests, the
+    8-virtual-device dryrun, the host fallback) gets the small-graph form.
+
+Carries are planar shift-mask chains in both forms. This is the TPU-native
+replacement for curve25519-voi's assembly field element (reference backend
+of crypto/ed25519/ed25519.go:27-29).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -51,10 +64,6 @@ _P_LIMBS = [int(x) for x in int_to_limbs(P_INT)]
 # non-negative limb-wise under the loose invariant.
 _FOUR_P = np.array([4 * x for x in _P_LIMBS], np.int32).reshape(LIMBS, 1)
 
-# Wrap weights for the parallel carry: carry out of limb 16 re-enters limb 0
-# multiplied by 19 (2^255 = 19 mod p); all other carries shift up one limb.
-_WRAP = np.array([19] + [1] * (LIMBS - 1), np.int32).reshape(LIMBS, 1)
-
 
 def const_fe(v: int) -> jnp.ndarray:
     """Field constant as int32[17, 1] (broadcasts over the batch)."""
@@ -81,19 +90,77 @@ def fe_to_bytes_le(x) -> np.ndarray:
     return np.packbits(bits, axis=1, bitorder="little")
 
 
-def _carry(x: jnp.ndarray) -> jnp.ndarray:
-    """One parallel carry pass: split each limb at 15 bits, shift carries up
-    one limb (top carry wraps to limb 0 with factor 19)."""
-    c = x >> LIMB_BITS
-    r = x & MASK
-    return r + jnp.roll(c, 1, axis=0) * jnp.asarray(_WRAP)
+# -- planar internals --------------------------------------------------------
+#
+# Rows of a [17, N] field element are sliced into 17 independent [N] arrays,
+# operated on as plain SSA values, and re-stacked only at op boundaries; XLA's
+# slice-of-concat simplification makes chained ops planar end-to-end.
 
+
+def _rows(x) -> list:
+    return [x[i] for i in range(LIMBS)]
+
+
+def _carry_rows(c: list) -> list:
+    """One parallel carry pass over 17 planar columns: split each at 15 bits,
+    carry up one limb, top carry wraps to limb 0 with factor 19."""
+    hi = [v >> LIMB_BITS for v in c]
+    lo = [v & MASK for v in c]
+    out = [lo[0] + 19 * hi[LIMBS - 1]]
+    for k in range(1, LIMBS):
+        out.append(lo[k] + hi[k - 1])
+    return out
+
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack(_carry_rows(_rows(x)))
+
+
+def _mul_rows(xs: list, ys: list) -> list:
+    """289 limb products, 15-bit split per product, planar column sums,
+    19-fold, two carry passes. Returns 17 loose planar columns."""
+    cols = [None] * (2 * LIMBS)
+
+    def acc(k, v):
+        cols[k] = v if cols[k] is None else cols[k] + v
+
+    for i in range(LIMBS):
+        for j in range(LIMBS):
+            p = xs[i] * ys[j]
+            acc(i + j, p & MASK)
+            acc(i + j + 1, p >> LIMB_BITS)
+    folded = [cols[k] + 19 * cols[k + LIMBS] for k in range(LIMBS)]
+    return _carry_rows(_carry_rows(folded))
+
+
+def _sq_rows(xs: list) -> list:
+    """Squaring: 153 products (symmetry), cross terms doubled AFTER the
+    15-bit split (2*p would overflow int32 at loose-limb maxima)."""
+    cols = [None] * (2 * LIMBS)
+
+    def acc(k, v):
+        cols[k] = v if cols[k] is None else cols[k] + v
+
+    for i in range(LIMBS):
+        p = xs[i] * xs[i]
+        acc(2 * i, p & MASK)
+        acc(2 * i + 1, p >> LIMB_BITS)
+        for j in range(i + 1, LIMBS):
+            p = xs[i] * xs[j]
+            acc(i + j, (p & MASK) * 2)
+            acc(i + j + 1, (p >> LIMB_BITS) * 2)
+    folded = [cols[k] + 19 * cols[k + LIMBS] for k in range(LIMBS)]
+    return _carry_rows(_carry_rows(folded))
+
+
+# -- compact (matmul-accumulation) multiply for the CPU backend --------------
 
 # One-hot accumulation matrix: entry [k, j*17+i] = 1 where the low half of
 # product x_i*y_j lands in column i+j, and [k, 289 + j*17+i] = 1 where the
-# high half lands in column i+j+1. One f32 matmul replaces 34 pad+adds —
-# a single MXU-friendly op with exact integer arithmetic (all values < 2^21
-# are exactly representable in float32).
+# high half lands in column i+j+1. One f32 matmul replaces ~580 adds; exact
+# because every UNWEIGHTED column sum stays under 2^21 (f32 integer-exact
+# range) — the 19-fold happens afterwards in int32, where a folded column
+# can exceed 2^24 and would NOT be f32-exact.
 _ACC = np.zeros((2 * LIMBS, 2 * LIMBS * LIMBS), np.float32)
 for _j in range(LIMBS):
     for _i in range(LIMBS):
@@ -101,12 +168,9 @@ for _j in range(LIMBS):
         _ACC[_i + _j + 1, LIMBS * LIMBS + _j * LIMBS + _i] = 1.0
 
 
-def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """z = x*y mod p under the loose invariant. Schoolbook [17,17,N] product,
-    15-bit split, one-hot f32 matmul column accumulation (exact: columns
-    < 2^21), 19-fold, two parallel carry passes."""
+def _mul_compact(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     n = x.shape[1]
-    p = x[None, :, :] * y[:, None, :]  # [j, i, N] int32, < 2^30.1
+    p = x[None, :, :] * y[:, None, :]  # [j, i, N] int32, < 2^30.2
     lo = (p & MASK).astype(jnp.float32).reshape(LIMBS * LIMBS, n)
     hi = (p >> LIMB_BITS).astype(jnp.float32).reshape(LIMBS * LIMBS, n)
     flat = jnp.concatenate([lo, hi], axis=0)  # [578, N]
@@ -120,8 +184,32 @@ def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return _carry(_carry(folded))
 
 
+_PLANAR: bool | None = None
+
+
+def _use_planar() -> bool:
+    """Planar lowering on accelerators, compact on CPU (see module
+    docstring). Matched by exclusion: the TPU tunnel on this deployment
+    registers its PJRT platform as "axon", not "tpu". The backend is sampled
+    once per process — mixed-backend processes would need per-trace plumbing
+    this framework doesn't require."""
+    global _PLANAR
+    if _PLANAR is None:
+        _PLANAR = jax.default_backend() != "cpu"
+    return _PLANAR
+
+
+def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """z = x*y mod p under the loose invariant."""
+    if _use_planar():
+        return jnp.stack(_mul_rows(_rows(x), _rows(y)))
+    return _mul_compact(x, y)
+
+
 def fe_sq(x: jnp.ndarray) -> jnp.ndarray:
-    return fe_mul(x, x)
+    if _use_planar():
+        return jnp.stack(_sq_rows(_rows(x)))
+    return _mul_compact(x, x)
 
 
 def fe_add(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:

@@ -123,6 +123,37 @@ def merkle_root(leaves: list[bytes]) -> bytes:
     return sha.digest_words_to_bytes(root)[0]
 
 
+def leaves_to_root_core(blocks, nblocks):
+    """ONE jittable program: leaf-hash all padded messages AND reduce the
+    full tree to the root. blocks uint32[B, 16, n] (n a power of two),
+    nblocks int32[n] -> uint32[8, 1]. Fusing the leaf pass and the log2(n)
+    inner levels into a single dispatch matters on tunneled deployments
+    where each dispatch costs a host round-trip."""
+    cur = _leaf_core(blocks, nblocks)
+    while cur.shape[1] > 1:
+        cur = _inner_core(cur[:, 0::2], cur[:, 1::2])
+    return cur
+
+
+@functools.lru_cache(maxsize=None)
+def _leaves_to_root_jit(bmax: int, n: int):
+    return jax.jit(leaves_to_root_core)
+
+
+def merkle_root_fused(leaves: list[bytes]) -> bytes:
+    """RFC-6962 root in one device dispatch (power-of-two leaf counts; the
+    general path pads via duplicate-free promotion in merkle_root)."""
+    n = len(leaves)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n & (n - 1):
+        return merkle_root(leaves)
+    msgs = [b"\x00" + it for it in leaves]
+    blocks, nblocks = sha.pack_messages(msgs)
+    out = _leaves_to_root_jit(blocks.shape[0], n)(blocks, nblocks)
+    return sha.digest_words_to_bytes(np.asarray(out))[0]
+
+
 @functools.lru_cache(maxsize=None)
 def _tree_root_jit(n: int):
     """ONE compiled program reducing uint32[8, n] (n a power of two) leaf

@@ -68,7 +68,10 @@ class TpuBackend(VerifyBackend):
         return self._ed.batch_verify(pubs, msgs, sigs)
 
     def merkle_root(self, leaves):
-        return self._merkle.merkle_root(leaves)
+        # Power-of-two forests take the fused single-dispatch program (one
+        # host round-trip instead of 2 + log-levels); merkle_root_fused
+        # falls back to the level loop for ragged counts.
+        return self._merkle.merkle_root_fused(leaves)
 
 
 _backend: VerifyBackend | None = None
