@@ -158,7 +158,12 @@ class BlocksyncReactor(Reactor):
                     self.switch.broadcast(BLOCKSYNC_CHANNEL, encode_status_request())
             if self._try_sync_one():
                 continue  # immediately try the next pair
-            if self.pool.is_caught_up() and self.pool.max_peer_height > 0:
+            # IsCaughtUp needs >= 1 peer STATUS (pool._peers non-empty), so a
+            # fresh all-genesis net switches to consensus as soon as statuses
+            # arrive — matching reactor.go's switchToConsensusTicker, which
+            # gates on IsCaughtUp alone (a max-height>0 guard would deadlock
+            # the everyone-at-height-0 boot).
+            if self.pool.is_caught_up():
                 self.synced = True
                 if self.on_caught_up:
                     self.on_caught_up(self.state)

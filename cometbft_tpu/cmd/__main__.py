@@ -131,6 +131,7 @@ def cmd_devnet(args) -> int:
     nodes = []
     for i, pv in enumerate(pvs):
         cfg = test_config()
+        cfg.p2p.laddr = ""  # in-memory broadcast mesh, no sockets
         cfg.base.db_backend = "memdb"
         cfg.consensus.timeout_commit = args.block_interval
         cfg.consensus.skip_timeout_commit = False

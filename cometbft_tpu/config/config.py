@@ -123,8 +123,10 @@ class StateSyncConfig:
 
 @dataclass
 class BlockSyncConfig:
-    """config/config.go:850-880."""
+    """config/config.go:850-880 (+ the top-level BlockSyncMode toggle,
+    config.go:85)."""
 
+    enable: bool = True
     version: str = "v0"
 
 
@@ -240,5 +242,8 @@ def test_config() -> Config:
         peer_query_maj23_sleep_duration=0.25,
     )
     c.rpc.laddr = "tcp://127.0.0.1:36657"
-    c.p2p.laddr = "tcp://127.0.0.1:36656"
+    # No p2p listener by default: unit tests wire in-process meshes (or
+    # explicitly set an ephemeral tcp://127.0.0.1:0 when they want sockets);
+    # a fixed shared port would collide across the multi-node tests.
+    c.p2p.laddr = ""
     return c

@@ -56,6 +56,11 @@ _KEY_TYPE_TO_CLASS = {
     ed25519.KEY_TYPE: (ed25519.PubKey, ed25519.PUB_KEY_SIZE),
     secp256k1.KEY_TYPE: (secp256k1.PubKey, secp256k1.PUB_KEY_SIZE),
     bn254.KEY_TYPE: (bn254.PubKey, bn254.PUB_KEY_SIZE),
+    # Amino-style names as they appear on the JSON wire (genesis files, RPC
+    # /validators responses — types/genesis.go + rpc serialization).
+    ed25519.PUB_KEY_NAME: (ed25519.PubKey, ed25519.PUB_KEY_SIZE),
+    secp256k1.PUB_KEY_NAME: (secp256k1.PubKey, secp256k1.PUB_KEY_SIZE),
+    bn254.PUB_KEY_NAME: (bn254.PubKey, bn254.PUB_KEY_SIZE),
 }
 
 

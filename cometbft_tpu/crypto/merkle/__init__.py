@@ -8,6 +8,8 @@ from cometbft_tpu.crypto.merkle.proof import (
     proofs_from_byte_slices,
 )
 from cometbft_tpu.crypto.merkle.proof_op import (
+    ProofOp,
+    ProofOps,
     ProofOperator,
     ProofOperators,
     ProofRuntime,
@@ -21,6 +23,8 @@ from cometbft_tpu.crypto.merkle.tree import (
 )
 
 __all__ = [
+    "ProofOp",
+    "ProofOps",
     "MAX_AUNTS",
     "Proof",
     "ProofOperator",

@@ -2,12 +2,23 @@
 :371 OnStart, node/setup.go:64 DefaultNewNode).
 
 Assembly order mirrors the reference: DBs → state → ABCI conns → handshake
-replay → event bus + indexers → mempool/evidence/executor → consensus → RPC.
+replay → event bus + indexers → mempool/evidence/executor → consensus →
+P2P switch + reactors → RPC.
+
+Boot phasing (node/node.go:423-433): when statesync is enabled and the
+store is empty, OnStart runs the light-client-verified snapshot restore
+first, hands the bootstrapped state to blocksync (SwitchToBlockSync), and
+blocksync's caught-up hook starts consensus. Without statesync, blocksync
+runs from the store head unless this node is the only validator
+(onlyValidatorIsUs, node/node.go:174), in which case consensus starts
+immediately.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 from cometbft_tpu.abci.client import LocalClientCreator
 from cometbft_tpu.abci.example.kvstore import KVStoreApplication
@@ -129,6 +140,62 @@ class Node:
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
 
+        # Boot mode (node/node.go:174 onlyValidatorIsUs + :423 stateSync
+        # gating: statesync only ever runs into an empty store).
+        self._state_sync = bool(config.statesync.enable) and state.last_block_height == 0
+        self._block_sync = config.blocksync.enable and not _only_validator_is_us(
+            state, priv_validator
+        )
+
+        # P2P switch + reactors (node/node.go:285-345), assembled whenever a
+        # p2p listen address is configured; in-process meshes (devnet) leave
+        # it empty and wire consensus broadcast directly.
+        self.switch = None
+        self.p2p_laddr = ""
+        if config.p2p.laddr:
+            from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+            from cometbft_tpu.consensus.reactor import ConsensusReactor
+            from cometbft_tpu.evidence.reactor import EvidenceReactor
+            from cometbft_tpu.mempool.reactor import MempoolReactor
+            from cometbft_tpu.p2p.key import NodeKey
+            from cometbft_tpu.p2p.node_info import NodeInfo
+            from cometbft_tpu.p2p.switch import Switch
+            from cometbft_tpu.p2p.transport import MultiplexTransport
+            from cometbft_tpu.statesync import StatesyncReactor
+
+            if config.base.root_dir:
+                self.node_key = NodeKey.load_or_gen(config.base.node_key_path())
+            else:
+                self.node_key = NodeKey()
+            self.node_info = NodeInfo(
+                node_id=self.node_key.id,
+                network=genesis_doc.chain_id,
+                moniker=config.base.moniker,
+            )
+            self.switch = Switch(
+                self.node_info,
+                MultiplexTransport(self.node_info, self.node_key),
+                config=config.p2p,
+            )
+            self.consensus_reactor = ConsensusReactor(self.consensus_state)
+            self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+            self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+            self.blocksync_reactor = BlocksyncReactor(
+                self.consensus_state.state,
+                self.block_executor,
+                self.block_store,
+                block_sync=self._block_sync and not self._state_sync,
+                on_caught_up=self._on_blocksync_caught_up,
+            )
+            self.statesync_reactor = StatesyncReactor(
+                snapshot_conn=self.proxy_app.snapshot
+            )
+            self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+            self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+            self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+            self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+            self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
         # RPC (node/node.go:392 startRPC).
         self.rpc_server = None
         self._rpc_env = None
@@ -137,8 +204,25 @@ class Node:
 
     def start(self) -> None:
         """node/node.go:371 OnStart (event bus/indexer already run from
-        __init__, as in NewNode)."""
-        self.consensus_state.start()
+        __init__, as in NewNode): p2p listen + dial, then the statesync →
+        blocksync → consensus phase chain."""
+        if self.switch is not None:
+            host, port = _parse_laddr(self.config.p2p.laddr)
+            self.p2p_laddr = self.switch.start(f"{host}:{port}")
+            for addr in self.config.p2p.persistent_peers.split(","):
+                addr = addr.strip()
+                if addr:
+                    self.switch.dial_peer(addr)
+
+        if self._state_sync and self.switch is not None:
+            threading.Thread(
+                target=self._statesync_routine, daemon=True, name="statesync"
+            ).start()
+        elif self._block_sync and self.switch is not None:
+            pass  # blocksync reactor's pool routine runs; caught-up hook
+            # starts consensus (_on_blocksync_caught_up)
+        else:
+            self.consensus_state.start()
         rpc_laddr = self.config.rpc.laddr
         if rpc_laddr:
             host, port = _parse_laddr(rpc_laddr)
@@ -166,6 +250,8 @@ class Node:
 
     def stop(self) -> None:
         self.consensus_state.stop()
+        if self.switch is not None:
+            self.switch.stop()
         self.indexer_service.stop()
         self.event_bus.stop()
         if self.rpc_server:
@@ -174,6 +260,79 @@ class Node:
     @property
     def rpc_port(self) -> int:
         return self.rpc_server.port if self.rpc_server else 0
+
+    # -- boot phases (node/node.go:423-433) -----------------------------------
+
+    def _on_blocksync_caught_up(self, state) -> None:
+        """blocksync's SwitchToConsensus hook (blocksync/reactor.go:392)."""
+        self.consensus_state.update_to_state(state)
+        self.consensus_state.start()
+
+    def _make_state_provider(self):
+        """node/setup.go-style light StateProvider over the configured RPC
+        servers (config.go StateSyncConfig.RPCServers)."""
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.statesync import LightClientStateProvider
+        from cometbft_tpu.types import cmttime
+
+        cfg = self.config.statesync
+        if not cfg.rpc_servers:
+            raise ValueError("statesync.rpc_servers must be set when statesync is enabled")
+        providers = [
+            HTTPProvider(self.genesis_doc.chain_id, HTTPClient(s))
+            for s in cfg.rpc_servers
+        ]
+        return LightClientStateProvider(
+            self.genesis_doc.chain_id,
+            providers[0],
+            providers[1:],
+            trust_height=cfg.trust_height,
+            trust_hash=bytes.fromhex(cfg.trust_hash),
+            trust_period_ns=int(cfg.trust_period * 10**9),
+            consensus_params=self.consensus_state.state.consensus_params,
+            now=cmttime.now,
+        )
+
+    def _statesync_routine(self) -> None:
+        """node/node.go:423-433 startStateSync: snapshot restore verified by
+        the light client, store bootstrap, then SwitchToBlockSync — whose
+        caught-up hook starts consensus."""
+        from cometbft_tpu.statesync import Syncer
+
+        cfg = self.config.statesync
+        try:
+            provider = self._make_state_provider()
+            syncer = Syncer(
+                self.proxy_app.snapshot,
+                self.proxy_app.query,
+                provider,
+                self.statesync_reactor.request_chunk,
+                chunk_timeout=cfg.chunk_request_timeout,
+                chunk_fetchers=cfg.chunk_fetchers,
+            )
+            self.statesync_reactor.set_syncer(syncer)
+            state, commit = syncer.sync_any(
+                discovery_time=cfg.discovery_time, timeout=600
+            )
+            self.state_store.bootstrap(state)
+            self.block_store.save_seen_commit(state.last_block_height, commit)
+            self.blocksync_reactor.switch_to_block_sync(state, self.block_executor)
+        except Exception as e:  # surface, don't kill the process
+            if self.logger:
+                self.logger.error(f"statesync failed: {e}")
+            else:
+                print(f"statesync failed: {e}")
+
+
+def _only_validator_is_us(state, priv_validator) -> bool:
+    """node/node.go:174: a 1-validator net that IS us must not wait for
+    blocksync peers before producing blocks."""
+    if priv_validator is None:
+        return False
+    if state.validators.size() != 1:
+        return False
+    return state.validators.validators[0].address == priv_validator.get_pub_key().address()
 
 
 def _parse_laddr(laddr: str) -> tuple[str, int]:
