@@ -196,6 +196,30 @@ class Node:
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
+            # PEX + address book (node/setup.go createPEXReactorAndAddToSwitch),
+            # unless discovery is disabled (config.go PexReactor).
+            self.pex_reactor = None
+            if config.p2p.pex:
+                from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+
+                book_path = (
+                    os.path.join(config.base.root_dir, config.p2p.addr_book_file)
+                    if config.base.root_dir
+                    else ""
+                )
+                self.addr_book = AddrBook(book_path, strict=config.p2p.addr_book_strict)
+                self.addr_book.add_our_address(self.node_key.id)
+                self.addr_book.add_private_ids(
+                    [i for i in config.p2p.private_peer_ids.split(",") if i]
+                )
+                self.pex_reactor = PexReactor(
+                    self.addr_book,
+                    seeds=[s.strip() for s in config.p2p.seeds.split(",") if s.strip()],
+                    seed_mode=config.p2p.seed_mode,
+                    max_outbound=config.p2p.max_num_outbound_peers,
+                )
+                self.switch.add_reactor("PEX", self.pex_reactor)
+
         # RPC (node/node.go:392 startRPC).
         self.rpc_server = None
         self._rpc_env = None

@@ -1,0 +1,7 @@
+"""Peer exchange: discovery reactor + address book
+(reference: p2p/pex/pex_reactor.go, p2p/pex/addrbook.go)."""
+
+from cometbft_tpu.p2p.pex.addrbook import AddrBook, NetAddress
+from cometbft_tpu.p2p.pex.reactor import PEX_CHANNEL, PexReactor
+
+__all__ = ["AddrBook", "NetAddress", "PexReactor", "PEX_CHANNEL"]

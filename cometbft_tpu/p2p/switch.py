@@ -92,6 +92,11 @@ class Switch:
         actual = ""
         if listen_addr:
             actual = self.transport.listen(listen_addr, self._on_inbound)
+            # Peers learn our dialable port from the handshake NodeInfo
+            # (PEX hands it on): record the ACTUAL bound address, which
+            # matters for the ephemeral :0 listeners tests use.
+            if not self.node_info.listen_addr or self.node_info.listen_addr.endswith(":0"):
+                self.node_info.listen_addr = actual
         return actual
 
     def stop(self) -> None:
