@@ -367,12 +367,24 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
 
 def default_new_node(config: Config, logger=None, app=None) -> Node:
     """node/setup.go:64 DefaultNewNode: files from config, kvstore app when
-    none supplied (proxy_app == "kvstore")."""
+    none supplied (proxy_app == "kvstore"); a remote signer when
+    priv_validator_laddr is set (node/node.go:181 createAndStartPrivValidator
+    SocketVal branch)."""
     genesis = GenesisDoc.from_file(config.base.genesis_path())
-    pv = FilePV.load_or_generate(
-        config.base.priv_validator_key_path(),
-        config.base.priv_validator_state_path(),
-    )
+    if config.base.priv_validator_laddr:
+        from cometbft_tpu.privval.signer import (
+            RetrySignerClient,
+            SignerClient,
+            SignerListenerEndpoint,
+        )
+
+        endpoint = SignerListenerEndpoint(config.base.priv_validator_laddr)
+        pv = RetrySignerClient(SignerClient(endpoint, genesis.chain_id))
+    else:
+        pv = FilePV.load_or_generate(
+            config.base.priv_validator_key_path(),
+            config.base.priv_validator_state_path(),
+        )
     if app is None:
         app = KVStoreApplication()
     return Node(config, genesis, pv, LocalClientCreator(app), logger)

@@ -1,5 +1,20 @@
 """Validator signing (reference: privval/, 1,770 LoC)."""
 
 from cometbft_tpu.privval.file import FilePV, LastSignState
+from cometbft_tpu.privval.signer import (
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
 
-__all__ = ["FilePV", "LastSignState"]
+__all__ = [
+    "FilePV",
+    "LastSignState",
+    "RemoteSignerError",
+    "RetrySignerClient",
+    "SignerClient",
+    "SignerListenerEndpoint",
+    "SignerServer",
+]

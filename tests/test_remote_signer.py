@@ -1,0 +1,169 @@
+"""Remote signer (reference: privval/signer_client.go + signer_server.go +
+retry_signer_client.go): key isolation in a separate process, double-sign
+guard held ACROSS signer restarts (the kill-point case), and a node
+committing blocks with its validator key behind the socket."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.privval import (
+    FilePV,
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from cometbft_tpu.types import BlockID, Time, Vote
+from cometbft_tpu.types.block import PRECOMMIT_TYPE, PREVOTE_TYPE
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.proposal import Proposal
+
+CHAIN = "signer-chain"
+
+
+def _vote(height=2, block_hash=b"\x01" * 32, vtype=PREVOTE_TYPE):
+    return Vote(
+        type=vtype, height=height, round=0,
+        block_id=BlockID(block_hash, PartSetHeader(1, b"\x02" * 32)),
+        timestamp=Time(1700000000, 0),
+        validator_address=b"\x03" * 20, validator_index=0,
+    )
+
+
+@pytest.fixture
+def wired(tmp_path):
+    """In-process signer pair over a unix socket."""
+    laddr = f"unix://{tmp_path}/pv.sock"
+    endpoint = SignerListenerEndpoint(laddr, accept_timeout=10.0)
+    pv = FilePV(
+        ed25519.gen_priv_key_from_secret(b"remote-pv"),
+        str(tmp_path / "key.json"),
+        str(tmp_path / "state.json"),
+    )
+    pv.save()
+    server = SignerServer(laddr, CHAIN, pv)
+    server.start()
+    client = SignerClient(endpoint, CHAIN)
+    yield client, server, pv, laddr, tmp_path
+    server.stop()
+    endpoint.close()
+
+
+def test_pub_key_and_ping(wired):
+    client, _, pv, *_ = wired
+    assert client.ping()
+    assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+
+
+def test_sign_vote_and_proposal_roundtrip(wired):
+    client, _, pv, *_ = wired
+    v = client.sign_vote(CHAIN, _vote())
+    assert v.signature and pv.get_pub_key().verify_signature(
+        v.sign_bytes(CHAIN), v.signature
+    )
+    p = Proposal(
+        height=3, round=0, pol_round=-1,
+        block_id=BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32)),
+        timestamp=Time(1700000001, 0),
+    )
+    sp = client.sign_proposal(CHAIN, p)
+    assert sp.signature and pv.get_pub_key().verify_signature(
+        sp.sign_bytes(CHAIN), sp.signature
+    )
+
+
+def test_double_sign_refused_over_the_wire_and_not_retried(wired):
+    client, *_ = wired
+    retry = RetrySignerClient(client, retries=3, timeout=0.05)
+    retry.sign_vote(CHAIN, _vote(block_hash=b"\x01" * 32))
+    t0 = time.time()
+    with pytest.raises(RemoteSignerError):
+        retry.sign_vote(CHAIN, _vote(block_hash=b"\x09" * 32))  # conflicting
+    # A signer REFUSAL must not be retried (retry_signer_client.go only
+    # retries transport errors): 3 retries x 50ms would take >= 100ms.
+    assert time.time() - t0 < 0.1
+
+
+def test_guard_survives_signer_restart(wired):
+    """Kill-point: state.json persists the last sign; a RESTARTED signer
+    process must refuse a conflicting vote at the same HRS and re-serve the
+    identical vote idempotently."""
+    client, server, pv, laddr, tmp_path = wired
+    signed = client.sign_vote(CHAIN, _vote(block_hash=b"\x01" * 32))
+    server.stop()
+    time.sleep(0.1)
+
+    pv2 = FilePV.load(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+    server2 = SignerServer(laddr, CHAIN, pv2)
+    server2.start()
+    try:
+        retry = RetrySignerClient(client, retries=20, timeout=0.1)
+        # same vote -> same signature (idempotent re-sign, file.go:318)
+        again = retry.sign_vote(CHAIN, _vote(block_hash=b"\x01" * 32))
+        assert again.signature == signed.signature
+        with pytest.raises(RemoteSignerError):
+            retry.sign_vote(CHAIN, _vote(block_hash=b"\x0a" * 32))
+    finally:
+        server2.stop()
+
+
+def test_node_commits_with_remote_signer_process(tmp_path):
+    """A single-validator node whose key lives in a separate OS process:
+    blocks must commit through the socket signer (node/node.go:181)."""
+    from cometbft_tpu.abci.client import LocalClientCreator
+    from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config as make_test_config
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    key_file = str(tmp_path / "key.json")
+    state_file = str(tmp_path / "state.json")
+    pv = FilePV(
+        ed25519.gen_priv_key_from_secret(b"node-remote-pv"), key_file, state_file
+    )
+    pv.save()
+    gen = GenesisDoc(
+        chain_id="rsigner-chain",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, "v0")
+        ],
+    )
+    gen.validate_and_complete()
+
+    laddr = f"unix://{tmp_path}/pv.sock"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu.privval.signer",
+         "--addr", laddr, "--chain-id", "rsigner-chain",
+         "--key-file", key_file, "--state-file", state_file],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    node = None
+    try:
+        endpoint = SignerListenerEndpoint(laddr, accept_timeout=20.0)
+        signer_pv = RetrySignerClient(SignerClient(endpoint, "rsigner-chain"))
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        node = Node(cfg, gen, signer_pv, LocalClientCreator(KVStoreApplication()))
+        node.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and node.consensus_state.rs.height < 4:
+            time.sleep(0.05)
+        assert node.consensus_state.rs.height >= 4, (
+            f"remote-signed chain stuck at {node.consensus_state.rs.height}"
+        )
+    finally:
+        if node is not None:
+            node.stop()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
