@@ -51,9 +51,15 @@ class KVStoreApplication(abci.Application):
         retain_blocks: int = 0,
         snapshot_interval: int = 0,
         snapshot_chunk_size: int = 65536,
+        provable: bool = False,
     ):
         self.db = db or MemDB()
         self.retain_blocks = retain_blocks
+        # Provable mode: AppHash is the SimpleMap Merkle root over the kv
+        # pairs and /store queries answer with ValueOp proofs — what the
+        # light proxy's verified abci_query needs (light/rpc/client.go:166;
+        # the reference kvstore itself doesn't prove, its e2e app does).
+        self.provable = provable
         # State-sync snapshots (reference: test/e2e/app/app.go:22-60 — the
         # purpose-built e2e app is the one that snapshots; plain kvstore.go
         # doesn't). Off unless snapshot_interval > 0.
@@ -133,7 +139,12 @@ class KVStoreApplication(abci.Application):
         return abci.ResponseProcessProposal(status=abci.PROCESS_PROPOSAL_ACCEPT)
 
     def commit(self):
-        app_hash = _put_varint_8(self.size)
+        if self.provable:
+            from cometbft_tpu.crypto.merkle import hash_from_byte_slices
+
+            app_hash = hash_from_byte_slices(self._kv_leaves()[1])
+        else:
+            app_hash = _put_varint_8(self.size)
         self.app_hash = app_hash
         self.height += 1
         self._save_state()
@@ -232,13 +243,50 @@ class KVStoreApplication(abci.Application):
 
     def query(self, req):
         value = self.db.get(_KV_PAIR_PREFIX + req.data)
-        return abci.ResponseQuery(
+        resp = abci.ResponseQuery(
             code=CODE_TYPE_OK,
             key=req.data,
             value=value or b"",
             log="exists" if value is not None else "does not exist",
             height=self.height,
         )
+        if req.prove and self.provable and value is not None:
+            resp.proof_ops = self._prove(req.data)
+        return resp
+
+    # -- provable-state helpers ------------------------------------------------
+
+    def _kv_leaves(self) -> tuple[list[bytes], list[bytes]]:
+        """Sorted keys and their SimpleMap leaf encodings
+        (crypto/merkle KVPair form: len-prefixed key || len-prefixed
+        SHA256(value) — the shape ValueOp.run reconstructs)."""
+        import hashlib
+
+        from cometbft_tpu.wire.proto import encode_bytes_len_prefixed
+
+        items = []
+        for k, v in self.db.iterator(_KV_PAIR_PREFIX, _KV_PAIR_PREFIX + b"\xff"):
+            items.append((k[len(_KV_PAIR_PREFIX):], v))
+        items.sort()
+        keys = [k for k, _ in items]
+        leaves = [
+            encode_bytes_len_prefixed(k)
+            + encode_bytes_len_prefixed(hashlib.sha256(v).digest())
+            for k, v in items
+        ]
+        return keys, leaves
+
+    def _prove(self, key: bytes) -> list:
+        from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
+        from cometbft_tpu.crypto.merkle.proof_value import ValueOp
+
+        keys, leaves = self._kv_leaves()
+        try:
+            idx = keys.index(key)
+        except ValueError:
+            return []
+        _, proofs = proofs_from_byte_slices(leaves)
+        return [ValueOp(key, proofs[idx]).proof_op()]
 
 
 class PersistentKVStoreApplication(KVStoreApplication):

@@ -171,6 +171,51 @@ def cmd_devnet(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """cmd/cometbft/commands/light.go: run a verifying light-client proxy
+    against a full node's RPC."""
+    from cometbft_tpu.libs.db import MemDB
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.provider import HTTPProvider
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.rpc.client import HTTPClient
+
+    primary = HTTPProvider(args.chain_id, HTTPClient(args.primary))
+    witnesses = [
+        HTTPProvider(args.chain_id, HTTPClient(w))
+        for w in args.witnesses.split(",")
+        if w
+    ]
+    if args.trusted_height > 0 and args.trusted_hash:
+        trust = TrustOptions(
+            period_ns=int(args.trust_period * 10**9),
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        )
+    else:
+        # Trust-on-first-use bootstrap from the primary's latest header.
+        lb = primary.light_block(0)
+        trust = TrustOptions(
+            period_ns=int(args.trust_period * 10**9), height=lb.height, hash=lb.hash()
+        )
+        print(f"trusting header {lb.height} ({lb.hash().hex().upper()}) from primary")
+    client = Client(
+        args.chain_id, trust, primary, witnesses, LightStore(MemDB()),
+        skip_verification="sequential" if args.sequential else "skipping",
+    )
+    host, _, port = args.laddr.split("://")[-1].rpartition(":")
+    proxy = LightProxy(client, HTTPClient(args.primary), host or "127.0.0.1", int(port))
+    proxy.start()
+    print(f"light proxy for {args.chain_id} on http://{host or '127.0.0.1'}:{proxy.port}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
+
+
 def cmd_show_validator(args) -> int:
     from cometbft_tpu.config import default_config
     from cometbft_tpu.privval import FilePV
@@ -297,6 +342,15 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc-port", type=int, default=26657)
     sp.add_argument("--block-interval", type=float, default=1.0)
     sp.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "auto"])
+    sp = sub.add_parser("light")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary node RPC URL")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC URLs")
+    sp.add_argument("--trusted-height", type=int, default=0)
+    sp.add_argument("--trusted-hash", default="")
+    sp.add_argument("--trust-period", type=float, default=168 * 3600.0)
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--sequential", action="store_true")
     sub.add_parser("show-validator")
     sub.add_parser("show-node-id")
     sub.add_parser("gen-validator")
@@ -314,6 +368,7 @@ def main(argv=None) -> int:
         "init": cmd_init,
         "start": cmd_start,
         "devnet": cmd_devnet,
+        "light": cmd_light,
         "show-validator": cmd_show_validator,
         "show-node-id": cmd_show_node_id,
         "gen-validator": cmd_gen_validator,

@@ -88,6 +88,7 @@ class ConsensusReactor(Reactor):
     def start(self) -> None:
         self._running = True
         threading.Thread(target=self._broadcast_round_step_routine, daemon=True).start()
+        threading.Thread(target=self._query_maj23_routine, daemon=True).start()
 
     def stop(self) -> None:
         self._running = False
@@ -114,10 +115,41 @@ class ConsensusReactor(Reactor):
                 ps.apply_new_round_step(msg)
             elif isinstance(msg, cmsg.HasVoteMessage) and ps:
                 ps.mark_vote_sent((msg.height, msg.round, msg.type, msg.index))
+            elif isinstance(msg, cmsg.VoteSetMaj23Message):
+                # reactor.go:300-340: record the claimed majority, then tell
+                # the peer which of those votes we ALREADY have.
+                rs = self.cs.rs
+                if msg.height != rs.height or rs.votes is None:
+                    return
+                self.cs.rs.votes.set_peer_maj23(
+                    msg.round, msg.type, peer.id, msg.block_id
+                )
+                from cometbft_tpu.types.block import PREVOTE_TYPE
+
+                vote_set = (
+                    rs.votes.prevotes(msg.round)
+                    if msg.type == PREVOTE_TYPE
+                    else rs.votes.precommits(msg.round)
+                )
+                our = vote_set.bit_array_by_block_id(msg.block_id) if vote_set else None
+                peer.try_send(
+                    CONSENSUS_VOTE_SET_BITS_CHANNEL,
+                    cmsg.encode_consensus_message(
+                        cmsg.VoteSetBitsMessage(
+                            height=msg.height, round=msg.round, type=msg.type,
+                            block_id=msg.block_id, votes=our,
+                        )
+                    ),
+                )
         elif chan_id in (CONSENSUS_DATA_CHANNEL, CONSENSUS_VOTE_CHANNEL):
             self.cs.send_peer_message(msg, peer_id=peer.id)
         elif chan_id == CONSENSUS_VOTE_SET_BITS_CHANNEL:
-            pass  # maj23 answers — bookkeeping only in this implementation
+            # The peer's answer to our VoteSetMaj23: which of those votes it
+            # already has — gossip skips them (reactor.go:377-402).
+            if isinstance(msg, cmsg.VoteSetBitsMessage) and ps and msg.votes:
+                for i in range(msg.votes.size()):
+                    if msg.votes.get_index(i):
+                        ps.mark_vote_sent((msg.height, msg.round, msg.type, i))
 
     # -- own-message gossip ---------------------------------------------------
 
@@ -159,6 +191,48 @@ class ConsensusReactor(Reactor):
             CONSENSUS_STATE_CHANNEL,
             cmsg.encode_consensus_message(self._round_step_msg(self.cs.rs)),
         )
+
+    # -- maj23 queries (reactor.go:827 queryMaj23Routine) ----------------------
+
+    def _query_maj23_routine(self) -> None:
+        """Tell peers at our height about any 2/3 majority we observe, so a
+        lagging/partitioned peer learns a quorum exists and can answer with
+        the votes it still needs (liveness under partial gossip)."""
+        from cometbft_tpu.types.block import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+        interval = getattr(
+            self.cs.config, "peer_query_maj23_sleep_duration", 2.0
+        )
+        while self._running:
+            time.sleep(interval)
+            rs = self.cs.rs
+            if rs.votes is None or self.switch is None:
+                continue
+            claims = []
+            for vtype, vote_set in (
+                (PREVOTE_TYPE, rs.votes.prevotes(rs.round)),
+                (PRECOMMIT_TYPE, rs.votes.precommits(rs.round)),
+            ):
+                if vote_set is None:
+                    continue
+                block_id, ok = vote_set.two_thirds_majority()
+                if ok:
+                    claims.append((vtype, rs.round, block_id))
+            if not claims:
+                continue
+            for ps in list(self.peer_states.values()):
+                if ps.height != rs.height:
+                    continue
+                for vtype, round_, block_id in claims:
+                    ps.peer.try_send(
+                        CONSENSUS_STATE_CHANNEL,
+                        cmsg.encode_consensus_message(
+                            cmsg.VoteSetMaj23Message(
+                                height=rs.height, round=round_, type=vtype,
+                                block_id=block_id,
+                            )
+                        ),
+                    )
 
     # -- per-peer gossip (reactor.go:535 gossipDataRoutine + :694 votes) ------
 

@@ -89,6 +89,7 @@ class ConsensusState:
         ticker: TimeoutTicker | None = None,
         logger=None,
         name: str = "",
+        metrics=None,
     ):
         self.config = config
         self.block_exec = block_exec
@@ -100,6 +101,9 @@ class ConsensusState:
         self.ticker = ticker or TimeoutTicker()
         self.logger = logger
         self.name = name
+        from cometbft_tpu.consensus.metrics import Metrics as _CsMetrics
+
+        self.metrics = metrics or _CsMetrics()
 
         self.rs = RoundState()
         self.state = None  # sm.State, set in update_to_state
@@ -457,6 +461,7 @@ class ConsensusState:
         rs.round = round_
         rs.step = STEP_NEW_ROUND
         rs.validators = validators
+        self.metrics.rounds.set(round_)
         if round_ != 0:
             rs.proposal = None
             rs.proposal_block = None
@@ -789,10 +794,32 @@ class ConsensusState:
                 self.block_store.prune_blocks(retain_height)
             except Exception:
                 pass
+        self._record_commit_metrics(block)
         self.update_to_state(state_copy)
         if self.priv_validator is not None:
             self.priv_validator_pub_key = self.priv_validator.get_pub_key()
         self._schedule_round0()
+
+    def _record_commit_metrics(self, block) -> None:
+        """consensus/state.go recordMetrics (:1726-1790 subset)."""
+        m = self.metrics
+        h = block.header.height
+        m.height.set(h)
+        m.latest_block_height.set(h)
+        m.validators.set(self.rs.validators.size())
+        m.validators_power.set(self.rs.validators.total_voting_power())
+        ntxs = len(block.data.txs)
+        m.num_txs.set(ntxs)
+        if ntxs:
+            m.total_txs.inc(ntxs)
+        m.block_size_bytes.set(len(block.encode()))
+        prev = self.block_store.load_block_meta(h - 1)
+        if prev is not None and prev.header.time is not None:
+            dt = (block.header.time.seconds - prev.header.time.seconds) + (
+                block.header.time.nanos - prev.header.time.nanos
+            ) / 1e9
+            if dt >= 0:
+                m.block_interval_seconds.observe(dt)
 
     # -- proposals ------------------------------------------------------------
 

@@ -1,12 +1,16 @@
-"""Light-client RPC proxy (reference: light/proxy/proxy.go + routes.go).
+"""Light-client RPC proxy (reference: light/proxy/proxy.go + routes.go +
+light/rpc/client.go for the verifying wrappers).
 
 Serves a subset of the node RPC, where every piece of returned data is
 verified through the light client before being handed to the caller: headers
-and commits come from the verified store, ABCI query results are checked
-against the verified app hash chain (merkle proof checking is the app's
-ProofOps contract)."""
+and commits come from the verified store; ABCI query results must carry
+merkle ProofOps, which are checked against the app hash of the verified
+header at height+1 (light/rpc/client.go:132-190)."""
 
 from __future__ import annotations
+
+import base64
+import urllib.parse
 
 from cometbft_tpu.rpc.jsonrpc.server import JSONRPCServer, RPCError
 
@@ -15,7 +19,20 @@ def _hexu(b: bytes) -> str:
     return b.hex().upper()
 
 
-def proxy_routes(client, rpc_client) -> dict:
+def default_merkle_key_path_fn(path: str, key: bytes) -> str:
+    """light/rpc/client.go:72 DefaultMerkleKeyPathFn for cosmos-style
+    '/store/<name>/key' paths, falling back to a single-segment key path for
+    flat single-store apps (the provable kvstore)."""
+    from cometbft_tpu.crypto.merkle.proof_key_path import KeyEncoding, KeyPath
+
+    kp = KeyPath()
+    parts = path.split("/")
+    if len(parts) >= 3 and parts[1] == "store" and parts[-1] == "key":
+        kp = kp.append_key("/".join(parts[2:-1]).encode(), KeyEncoding.URL)
+    return str(kp.append_key(key, KeyEncoding.HEX))
+
+
+def proxy_routes(client, rpc_client, key_path_fn=default_merkle_key_path_fn) -> dict:
     """light/proxy/routes.go: verified subset + passthrough."""
 
     def status():
@@ -66,15 +83,64 @@ def proxy_routes(client, rpc_client) -> dict:
         }
 
     def abci_query(path="", data="", height=None, prove=True):
-        """Passthrough with height pinned to a verified header (proxy
-        guarantees the response's height is verifiable; full merkle proof
-        checking requires the app's proof ops)."""
+        """light/rpc/client.go:132 ABCIQueryWithOptions: force prove,
+        require proof ops, and verify the value (or absence) proof against
+        the app hash of the verified header at resp.height + 1."""
         res = rpc_client.call(
             "abci_query", path=path, data=data, height=height or "0", prove=True
         )
-        resp_height = int(res["response"].get("height", 0))
-        if resp_height > 0:
-            _verified(resp_height + 1)  # app hash for H is in header H+1
+        resp = res.get("response", {})
+        if int(resp.get("code", 0)) != 0:
+            raise RPCError(-32603, f"err response code: {resp.get('code')}", None)
+        key = base64.b64decode(resp.get("key") or "")
+        if not key:
+            raise RPCError(-32603, "empty key", None)
+        ops_json = (resp.get("proofOps") or {}).get("ops") or []
+        if not ops_json:
+            raise RPCError(-32603, "no proof ops", None)
+        resp_height = int(resp.get("height", 0))
+        if resp_height <= 0:
+            raise RPCError(-32603, "negative or zero height", None)
+        # App hash for H is in header H+1, which on a live chain lands one
+        # block interval after the query's height: retry briefly
+        # (light/rpc/client.go's updateLightClientIfNeededTo equivalent).
+        import time as _time
+
+        lb = None
+        deadline = _time.monotonic() + 5.0
+        while True:
+            try:
+                lb = _verified(resp_height + 1)
+                break
+            except Exception:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.1)
+
+        from cometbft_tpu.crypto.merkle import default_proof_runtime
+        from cometbft_tpu.crypto.merkle.proof_op import ProofOp, ProofOps
+
+        ops = ProofOps(
+            ops=[
+                ProofOp(
+                    type=o["type"],
+                    key=base64.b64decode(o.get("key") or ""),
+                    data=base64.b64decode(o.get("data") or ""),
+                )
+                for o in ops_json
+            ]
+        )
+        value = base64.b64decode(resp.get("value") or "")
+        prt = default_proof_runtime()
+        try:
+            if value:
+                prt.verify_value(
+                    ops, lb.header.app_hash, key_path_fn(path, key), value
+                )
+            else:
+                prt.verify_absence(ops, lb.header.app_hash, key_path_fn(path, key))
+        except Exception as e:
+            raise RPCError(-32603, f"proof verification failed: {e}", None)
         return res
 
     def broadcast_tx_commit(tx=""):

@@ -125,6 +125,31 @@ class Node:
             logger,
         )
 
+        # Metrics (node/node.go:385-387 + each subsystem's PrometheusMetrics).
+        self.metrics_registry = None
+        self.metrics_server = None
+        cs_metrics = None
+        if config.instrumentation.prometheus:
+            from cometbft_tpu.consensus.metrics import Metrics as CsMetrics
+            from cometbft_tpu.libs.metrics import MetricsServer, Registry
+
+            reg = Registry(namespace=config.instrumentation.namespace)
+            self.metrics_registry = reg
+            cs_metrics = CsMetrics(reg)
+            reg.gauge_func("mempool", "size", "Txs in the mempool.",
+                           lambda: self.mempool.size())
+            reg.gauge_func("p2p", "peers", "Connected peers.",
+                           lambda: self.switch.num_peers() if self.switch else 0)
+            reg.gauge_func("blockstore", "height", "Block store tip height.",
+                           lambda: self.block_store.height())
+            reg.gauge_func("blockstore", "base", "Block store base height.",
+                           lambda: self.block_store.base())
+            addr = config.instrumentation.prometheus_listen_addr
+            host, _, port = addr.rpartition(":")
+            self.metrics_server = MetricsServer(
+                reg, host.replace("tcp://", "") or "127.0.0.1", int(port)
+            )
+
         # Consensus (node/node.go:256).
         wal = WAL(config.consensus.wal_path()) if config.base.root_dir else None
         self.consensus_state = ConsensusState(
@@ -136,6 +161,7 @@ class Node:
             self.evidence_pool,
             self.event_bus,
             wal=wal,
+            metrics=cs_metrics,
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
@@ -238,6 +264,9 @@ class Node:
                 if addr:
                     self.switch.dial_peer(addr)
 
+        if self.metrics_server is not None:
+            self.metrics_server.start()
+
         if self._state_sync and self.switch is not None:
             threading.Thread(
                 target=self._statesync_routine, daemon=True, name="statesync"
@@ -274,6 +303,8 @@ class Node:
 
     def stop(self) -> None:
         self.consensus_state.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         if self.switch is not None:
             self.switch.stop()
         self.indexer_service.stop()

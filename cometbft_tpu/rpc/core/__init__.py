@@ -550,7 +550,7 @@ def routes(env: Environment) -> dict:
         res = env.proxy_app_query.query(
             abci.RequestQuery(data=raw, path=path, height=int(height), prove=bool(prove))
         )
-        return {
+        out = {
             "response": {
                 "code": res.code,
                 "log": res.log,
@@ -562,6 +562,14 @@ def routes(env: Environment) -> dict:
                 "codespace": res.codespace,
             }
         }
+        if res.proof_ops:
+            out["response"]["proofOps"] = {
+                "ops": [
+                    {"type": op.type, "key": _b64(op.key), "data": _b64(op.data)}
+                    for op in res.proof_ops
+                ]
+            }
+        return out
 
     # ---- evidence ----------------------------------------------------------
 

@@ -1,0 +1,126 @@
+"""Light proxy with VERIFIED abci_query (reference: light/proxy/routes.go +
+light/rpc/client.go:132): a provable kvstore node, a light client over its
+RPC, and a proxy that only returns merkle-verified query results."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.provider import HTTPProvider
+from cometbft_tpu.light.proxy import LightProxy
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.rpc.client import HTTPClient, RPCClientError
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN = "lproxy-chain"
+
+
+@pytest.fixture(scope="module")
+def live_node():
+    pv = FilePV(ed25519.gen_priv_key_from_secret(b"lproxy"))
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, "v0")
+        ],
+    )
+    gen.validate_and_complete()
+    cfg = make_test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    app = KVStoreApplication(provable=True)
+    node = Node(cfg, gen, pv, LocalClientCreator(app))
+    node.start()
+    node.mempool.check_tx(b"alpha=1")
+    node.mempool.check_tx(b"beta=2")
+    deadline = time.time() + 30
+    while time.time() < deadline and node.consensus_state.rs.height < 5:
+        time.sleep(0.05)
+    assert node.consensus_state.rs.height >= 5
+    yield node
+    node.stop()
+
+
+class _Tamperer:
+    """Wraps an rpc client, corrupting abci_query values."""
+
+    def __init__(self, inner, corrupt=False, strip_proofs=False):
+        self.inner = inner
+        self.corrupt = corrupt
+        self.strip_proofs = strip_proofs
+
+    def call(self, method, **params):
+        res = self.inner.call(method, **params)
+        if method == "abci_query":
+            if self.strip_proofs:
+                res["response"].pop("proofOps", None)
+            if self.corrupt:
+                import base64
+
+                res["response"]["value"] = base64.b64encode(b"evil").decode()
+        return res
+
+
+def _proxy(node, rpc_wrapper=None):
+    url = f"http://127.0.0.1:{node.rpc_port}"
+    provider = HTTPProvider(CHAIN, HTTPClient(url))
+    lb1 = provider.light_block(1)
+    client = Client(
+        CHAIN,
+        TrustOptions(period_ns=3600 * 10**9, height=1, hash=lb1.hash()),
+        provider,
+        [],
+        LightStore(MemDB()),
+    )
+    rpc = HTTPClient(url)
+    if rpc_wrapper:
+        rpc = rpc_wrapper(rpc)
+    proxy = LightProxy(client, rpc, port=0)
+    proxy.start()
+    return proxy
+
+
+def test_verified_abci_query_roundtrip(live_node):
+    proxy = _proxy(live_node)
+    try:
+        cli = HTTPClient(f"http://127.0.0.1:{proxy.port}")
+        res = cli.abci_query("/store", b"alpha", prove=True)
+        import base64
+
+        assert base64.b64decode(res["response"]["value"]) == b"1"
+        assert res["response"]["proofOps"]["ops"], "proof must ride through"
+        # verified headers too
+        status = cli.call("status")
+        assert int(status["sync_info"]["latest_block_height"]) >= 1
+    finally:
+        proxy.stop()
+
+
+def test_tampered_value_rejected(live_node):
+    proxy = _proxy(live_node, lambda rpc: _Tamperer(rpc, corrupt=True))
+    try:
+        cli = HTTPClient(f"http://127.0.0.1:{proxy.port}")
+        with pytest.raises(RPCClientError, match="proof verification failed"):
+            cli.abci_query("/store", b"alpha", prove=True)
+    finally:
+        proxy.stop()
+
+
+def test_missing_proofs_rejected(live_node):
+    proxy = _proxy(live_node, lambda rpc: _Tamperer(rpc, strip_proofs=True))
+    try:
+        cli = HTTPClient(f"http://127.0.0.1:{proxy.port}")
+        with pytest.raises(RPCClientError, match="no proof ops"):
+            cli.abci_query("/store", b"alpha", prove=True)
+    finally:
+        proxy.stop()

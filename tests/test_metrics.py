@@ -1,0 +1,82 @@
+"""Metrics (reference: consensus/metrics.go, node/node.go:385-387): the
+primitive library's exposition format and a live node's scrapeable
+endpoint showing height advancing."""
+
+import time
+import urllib.request
+
+from cometbft_tpu.libs.metrics import Counter, Gauge, Histogram, Registry
+
+
+def test_text_exposition_format():
+    reg = Registry(namespace="cmt")
+    c = reg.counter("cs", "total_txs", "Total txs.")
+    g = reg.gauge("cs", "height", "Height.", labels=("chain",))
+    h = reg.histogram("cs", "interval", "Interval.", buckets=(0.1, 1))
+    c.inc(3)
+    g.labels(chain="a").set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5)
+    reg.gauge_func("mempool", "size", "Size.", lambda: 42)
+    out = reg.render()
+    assert "# TYPE cmt_cs_total_txs counter" in out
+    assert "cmt_cs_total_txs 3" in out
+    assert 'cmt_cs_height{chain="a"} 7' in out
+    assert 'cmt_cs_interval_bucket{le="0.1"} 1' in out
+    assert 'cmt_cs_interval_bucket{le="1"} 2' in out
+    assert 'cmt_cs_interval_bucket{le="+Inf"} 3' in out
+    assert "cmt_cs_interval_count 3" in out
+    assert "cmt_mempool_size 42" in out
+
+
+def test_node_metrics_endpoint_height_advances():
+    from cometbft_tpu.abci.client import LocalClientCreator
+    from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    pv = FilePV(ed25519.gen_priv_key())
+    gen = GenesisDoc(
+        chain_id="metrics-chain",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, "v0")
+        ],
+    )
+    gen.validate_and_complete()
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+    node = Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
+    node.start()
+    try:
+        node.mempool.check_tx(b"metric=1")
+        deadline = time.time() + 30
+        while time.time() < deadline and node.consensus_state.rs.height < 4:
+            time.sleep(0.05)
+        url = f"http://127.0.0.1:{node.metrics_server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        def value_of(name):
+            for line in body.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            raise AssertionError(f"{name} not in scrape:\n{body}")
+
+        assert value_of("cometbft_consensus_height") >= 3
+        assert value_of("cometbft_consensus_latest_block_height") >= 3
+        assert value_of("cometbft_consensus_validators") == 1
+        assert value_of("cometbft_consensus_validators_power") == 10
+        assert value_of("cometbft_consensus_total_txs") >= 1
+        assert value_of("cometbft_blockstore_height") >= 3
+        assert "cometbft_consensus_block_interval_seconds_count" in body
+        assert "cometbft_mempool_size" in body
+        assert "cometbft_p2p_peers 0" in body
+    finally:
+        node.stop()
