@@ -1,0 +1,127 @@
+"""Byzantine consensus (reference: consensus/byzantine_test.go:40-80,
+TestByzantinePrevoteEquivocation): one of four validators equivocates
+prevotes; the three honest validators keep committing, the conflicting
+votes become DuplicateVoteEvidence through the consensus reporting path
+(state.go tryAddVote -> evpool.ReportConflictingVotes), and the evidence
+lands in a committed block."""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.consensus import messages as cmsg
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.types import BlockID, Vote, cmttime
+from cometbft_tpu.types.block import PREVOTE_TYPE
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+
+CHAIN = "byz-chain"
+
+
+def test_prevote_equivocation_lands_in_committed_block():
+    pvs = [MockPV() for _ in range(4)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+
+    def make(pv):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = ""
+        cfg.consensus.timeout_commit = 0.15
+        cfg.consensus.skip_timeout_commit = False
+        return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
+
+    nodes = [make(pv) for pv in pvs]
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < 2:
+            time.sleep(0.05)
+        assert cs0.rs.height >= 2, "net never started committing"
+
+        # Validator 3 equivocates: two signed prevotes for DIFFERENT fake
+        # blocks at its current height/round, broadcast over the real vote
+        # channel (byzantine_test.go's prevote branch).
+        byz_node, byz_pv = nodes[3], pvs[3]
+        byz_addr = byz_pv.address()
+
+        def byz_index(height):
+            vals = byz_node.consensus_state.state.validators
+            for idx, v in enumerate(vals.validators):
+                if v.address == byz_addr:
+                    return idx
+            raise AssertionError("byzantine validator not in set")
+
+        def equivocate_once():
+            rs = byz_node.consensus_state.rs
+            h, r = rs.height, rs.round
+            idx = byz_index(h)
+            now = cmttime.now()
+            for mark in (b"\xaa", b"\xbb"):
+                vote = Vote(
+                    type=PREVOTE_TYPE, height=h, round=r,
+                    block_id=BlockID(mark * 32, PartSetHeader(1, mark * 32)),
+                    timestamp=now,
+                    validator_address=byz_addr, validator_index=idx,
+                )
+                signed = byz_pv.sign_vote(CHAIN, vote)
+                byz_node.consensus_reactor._broadcast_own_message(
+                    cmsg.VoteMessage(signed)
+                )
+
+        def committed_duplicate_vote_evidence():
+            for n in nodes[:3]:
+                store = n.block_store
+                for h in range(1, store.height() + 1):
+                    block = store.load_block(h)
+                    if block is None:
+                        continue
+                    for ev in block.evidence:
+                        if isinstance(ev, DuplicateVoteEvidence) and (
+                            ev.vote_a.validator_address == byz_addr
+                        ):
+                            return h, ev
+            return None
+
+        found = None
+        deadline = time.time() + 90
+        while time.time() < deadline and found is None:
+            equivocate_once()
+            time.sleep(0.3)
+            found = committed_duplicate_vote_evidence()
+        assert found is not None, "duplicate-vote evidence never committed"
+        ev_height, ev = found
+        assert ev.vote_a.block_id != ev.vote_b.block_id
+        assert ev.vote_a.height == ev.vote_b.height
+
+        # The honest majority keeps committing after the attack.
+        target = cs0.rs.height + 2
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < target:
+            time.sleep(0.05)
+        assert cs0.rs.height >= target, "chain halted after equivocation"
+    finally:
+        for n in nodes:
+            n.stop()
