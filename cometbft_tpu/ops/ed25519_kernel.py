@@ -65,15 +65,22 @@ def _compiled(n: int):
     return jax.jit(verify_core)
 
 
-def warmup(buckets=(128, 1024, 10240)) -> None:
-    """Precompile the verify program for the given batch buckets ahead of
-    first use (SURVEY §7 hard part 3: the <2 ms latency budget cannot absorb
-    a per-call XLA compile). Shape-only: feeds all-zero operands of each
-    bucket's shape through the jit so the compiled executable (and the
-    persistent compile cache entry) exists before the first real commit."""
+def warmup(buckets=(128, 1024, 10240), merkle_leaves=(1024, 65536)) -> None:
+    """Precompile the verify program for the given batch buckets AND the
+    fused Merkle leaves->root program ahead of first use (SURVEY §7 hard
+    part 3: the <2 ms latency budget cannot absorb a per-call XLA compile).
+    Shape-only: feeds all-zero operands of each bucket's shape through the
+    jit so the compiled executable (and the persistent compile cache entry)
+    exists before the first real commit."""
     for b in buckets:
         operands, _ = pack_batch([b""] * b, [b""] * b, [b""] * b)
         jax.block_until_ready(_compiled(operands[0].shape[1])(*operands))
+    from cometbft_tpu.ops import merkle_kernel as mk
+
+    for n in merkle_leaves:
+        blocks = np.zeros((1, 16, n), np.uint32)
+        nblocks = np.ones(n, np.int32)
+        jax.block_until_ready(mk._leaves_to_root_jit(1, n)(blocks, nblocks))
 
 
 def _split_enc(enc: np.ndarray):
@@ -85,32 +92,49 @@ def _split_enc(enc: np.ndarray):
 
 
 def pack_batch(pubs, msgs, sigs):
-    """Host-side packing of one verification batch. Returns device operands
-    plus the host-decided validity mask (shape errors, s >= L)."""
+    """Host-side packing of one verification batch: vectorized numpy for
+    everything but the per-signature SHA-512 challenge (hashlib, C speed).
+    Returns device operands plus the host-decided validity mask (shape
+    errors, s >= L). Invalid entries are packed as zeros — lanes the device
+    evaluates but the mask vetoes."""
     n = len(pubs)
     nb = bucket_for(n)
+    zero_pub, zero_sig = b"\x00" * 32, b"\x00" * 64
+    shape_ok = [len(pubs[i]) == 32 and len(sigs[i]) == 64 for i in range(n)]
+    pubs_c = [pubs[i] if shape_ok[i] else zero_pub for i in range(n)]
+    sigs_c = [sigs[i] if shape_ok[i] else zero_sig for i in range(n)]
+
     a_enc = np.zeros((nb, 32), np.uint8)
     r_enc = np.zeros((nb, 32), np.uint8)
     s_le = np.zeros((nb, 32), np.uint8)
     k_le = np.zeros((nb, 32), np.uint8)
+    if n:
+        a_enc[:n] = np.frombuffer(b"".join(pubs_c), np.uint8).reshape(n, 32)
+        sig_arr = np.frombuffer(b"".join(sigs_c), np.uint8).reshape(n, 64)
+        r_enc[:n] = sig_arr[:, :32]
+        s_le[:n] = sig_arr[:, 32:]
+
     host_ok = np.zeros(nb, bool)
+    l_bytes = L.to_bytes(32, "little")
+    k_rows = bytearray(32 * n)
     for i in range(n):
-        pub, msg, sig = pubs[i], msgs[i], sigs[i]
-        if len(pub) != 32 or len(sig) != 64:
+        if not shape_ok[i]:
             continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:
+        s_bytes = sigs_c[i][32:]
+        # s < L: compare little-endian byte strings most-significant first.
+        if s_bytes[::-1] >= l_bytes[::-1]:
+            s_le[i] = 0
             continue
         h = hashlib.sha512()
-        h.update(sig[:32])
-        h.update(pub)
-        h.update(msg)
+        h.update(sigs_c[i][:32])
+        h.update(pubs_c[i])
+        h.update(msgs[i])
         k = int.from_bytes(h.digest(), "little") % L
-        a_enc[i] = np.frombuffer(pub, np.uint8)
-        r_enc[i] = np.frombuffer(sig[:32], np.uint8)
-        s_le[i] = np.frombuffer(sig[32:], np.uint8)
-        k_le[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+        k_rows[32 * i : 32 * (i + 1)] = k.to_bytes(32, "little")
         host_ok[i] = True
+    if n:
+        k_le[:n] = np.frombuffer(bytes(k_rows), np.uint8).reshape(n, 32)
+
     y_a, sign_a = _split_enc(a_enc)
     y_r, sign_r = _split_enc(r_enc)
     s_digits = ed.scalars_to_digits(s_le)

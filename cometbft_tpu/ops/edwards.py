@@ -315,19 +315,32 @@ def windowed_double_base_mult(s_digits: jnp.ndarray, k_digits: jnp.ndarray, a_po
 
 def scalars_to_digits(scalars: np.ndarray) -> np.ndarray:
     """uint8[N, 32] little-endian scalars (< 2^253) -> int32[64, N] signed
-    radix-16 digits in [-8, 8] (host). Row w has weight 16^w; digit 8 only
-    ever appears with positive sign (from the -8 recode's carry)."""
+    radix-16 digits in [-8, 7] (host). Row w has weight 16^w.
+
+    Vectorized via the add-8s identity: for t = s + 0x88...8 (64 eights),
+    nibble_w(t) - 8 is a valid signed digit string for s — the +8 absorbs
+    each nibble's worst-case borrow so no sequential carry loop is needed.
+    The big-int add runs as four uint64 word adds with a 3-step carry chain.
+    s < 2^253 keeps the top nibble <= 1+8, so t never overflows 256 bits."""
     n = scalars.shape[0]
-    nib = np.zeros((n, DIGITS), np.int32)
-    nib[:, 0::2] = scalars & 15
-    nib[:, 1::2] = scalars >> 4
-    digits = np.zeros((n, DIGITS), np.int32)
-    carry = np.zeros(n, np.int32)
-    for w in range(DIGITS):
-        d = nib[:, w] + carry
-        over = d > 8
-        digits[:, w] = np.where(over, d - 16, d)
-        carry = over.astype(np.int32)
-    # scalars < 2^253: top nibble <= 1, so the final carry is absorbed.
-    assert not carry.any(), "scalar exceeded 2^253 in signed-digit recode"
-    return np.ascontiguousarray(digits.T)
+    if n == 0:
+        return np.zeros((DIGITS, 0), np.int32)
+    words = (
+        np.ascontiguousarray(scalars, np.uint8).view("<u8").reshape(n, 4)
+    )
+    eights = np.uint64(0x8888888888888888)
+    t = np.zeros((n, 4), np.uint64)
+    carry = np.zeros(n, np.uint64)
+    with np.errstate(over="ignore"):
+        for w in range(4):
+            tw = words[:, w] + eights
+            wrapped = tw < words[:, w]
+            tw2 = tw + carry
+            wrapped |= (carry == 1) & (tw2 == 0)
+            t[:, w] = tw2
+            carry = wrapped.astype(np.uint64)
+    tb = t.view(np.uint8).reshape(n, 32)  # little-endian byte stream of t
+    nib = np.empty((n, DIGITS), np.int32)
+    nib[:, 0::2] = tb & 15
+    nib[:, 1::2] = tb >> 4
+    return np.ascontiguousarray((nib - 8).T)
