@@ -57,20 +57,24 @@ class DataCorruptionError(Exception):
 
 
 class WAL:
-    """consensus/wal.go baseWAL: file-backed, CRC-framed, fsync on demand."""
+    """consensus/wal.go baseWAL over a rotating autofile Group: CRC-framed
+    frames appended to the head file, rotated at head_size_limit so the WAL
+    no longer grows unboundedly in one file (libs/autofile/group.go)."""
 
-    def __init__(self, path: str, codec=None):
+    def __init__(self, path: str, codec=None, head_size_limit: int = 10 * 1024 * 1024):
+        from cometbft_tpu.libs.autofile import Group
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self._codec = codec or _default_codec
         self._decode = _default_decode
-        self._f = open(path, "ab")
+        self.group = Group(path, head_size_limit=head_size_limit)
         self._mtx = threading.Lock()
         self._running = True
 
     def start(self) -> None:
         """OnStart writes EndHeightMessage(0) into an empty WAL (wal.go:110)."""
-        if os.path.getsize(self.path) == 0:
+        if os.path.getsize(self.path) == 0 and not self.group.chunk_indices():
             self.write_sync(EndHeightMessage(0))
 
     def write(self, msg) -> None:
@@ -79,40 +83,36 @@ class WAL:
             return
         data = _encode_timed(self._codec, TimedWALMessage(cmttime.now(), msg))
         with self._mtx:
-            self._f.write(data)
+            self.group.write(data)
 
     def write_sync(self, msg) -> None:
         """Write + fsync — used for own messages so the node never signs
         without the intent being durable (wal.go WriteSync,
-        consensus/state.go:774)."""
+        consensus/state.go:774). Rotation is checked AFTER the frame lands
+        so a record never splits across chunk files."""
         if not self._running:
             return
         data = _encode_timed(self._codec, TimedWALMessage(cmttime.now(), msg))
         with self._mtx:
-            self._f.write(data)
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self.group.write(data)
+            self.group.flush_and_sync()
+        self.group.maybe_rotate()
 
     def flush_and_sync(self) -> None:
         with self._mtx:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self.group.flush_and_sync()
 
     def stop(self) -> None:
         with self._mtx:
             if self._running:
                 self._running = False
-                self._f.flush()
-                os.fsync(self._f.fileno())
-                self._f.close()
+                self.group.close()
 
     def reopen(self) -> None:
         """Re-open the append handle after an external rewrite (the repair
         path: state.go loadWalFile after repairWalFile)."""
         with self._mtx:
-            if self._running:
-                self._f.close()
-            self._f = open(self.path, "ab")
+            self.group.reopen()
             self._running = True
 
     # -- reading / replay -----------------------------------------------------
@@ -162,7 +162,7 @@ class WAL:
         per skippable corrupt frame (bad CRC with a plausible length — the
         reader can still advance); stop silently at a truncated/garbage tail
         (no resync possible without the reference's per-file groups)."""
-        with open(self.path, "rb") as f:
+        with self.group.reader() as f:
             while True:
                 hdr = f.read(8)
                 if len(hdr) < 8:
@@ -183,7 +183,7 @@ class WAL:
 
     def iter_messages(self):
         """Decode every frame; raises DataCorruptionError on a bad frame."""
-        with open(self.path, "rb") as f:
+        with self.group.reader() as f:
             while True:
                 hdr = f.read(8)
                 if len(hdr) == 0:

@@ -169,8 +169,10 @@ class Node:
         # Boot mode (node/node.go:174 onlyValidatorIsUs + :423 stateSync
         # gating: statesync only ever runs into an empty store).
         self._state_sync = bool(config.statesync.enable) and state.last_block_height == 0
-        self._block_sync = config.blocksync.enable and not _only_validator_is_us(
-            state, priv_validator
+        self._block_sync = (
+            config.base.block_sync
+            and config.blocksync.enable
+            and not _only_validator_is_us(state, priv_validator)
         )
 
         # P2P switch + reactors (node/node.go:285-345), assembled whenever a
@@ -259,6 +261,11 @@ class Node:
         if self.switch is not None:
             host, port = _parse_laddr(self.config.p2p.laddr)
             self.p2p_laddr = self.switch.start(f"{host}:{port}")
+            if self.logger:
+                self.logger.info(
+                    "p2p listening", module="p2p", addr=self.p2p_laddr,
+                    node_id=self.node_key.id,
+                )
             for addr in self.config.p2p.persistent_peers.split(","):
                 addr = addr.strip()
                 if addr:
@@ -367,9 +374,16 @@ class Node:
                 chunk_fetchers=cfg.chunk_fetchers,
             )
             self.statesync_reactor.set_syncer(syncer)
+            if self.logger:
+                self.logger.info("starting statesync", module="statesync")
             state, commit = syncer.sync_any(
                 discovery_time=cfg.discovery_time, timeout=600
             )
+            if self.logger:
+                self.logger.info(
+                    "snapshot restored; switching to blocksync",
+                    module="statesync", height=state.last_block_height,
+                )
             self.state_store.bootstrap(state)
             self.block_store.save_seen_commit(state.last_block_height, commit)
             self.blocksync_reactor.switch_to_block_sync(state, self.block_executor)
@@ -397,10 +411,15 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
 
 
 def default_new_node(config: Config, logger=None, app=None) -> Node:
-    """node/setup.go:64 DefaultNewNode: files from config, kvstore app when
-    none supplied (proxy_app == "kvstore"); a remote signer when
-    priv_validator_laddr is set (node/node.go:181 createAndStartPrivValidator
-    SocketVal branch)."""
+    """node/setup.go:64 DefaultNewNode: files from config; the app comes
+    from proxy_app — "kvstore"/"noop" in-process, otherwise a socket address
+    served by an external ABCI app (proxy/client.go DefaultClientCreator);
+    a remote signer when priv_validator_laddr is set (node/node.go:181
+    createAndStartPrivValidator SocketVal branch)."""
+    if logger is None:
+        from cometbft_tpu.libs.log import new_logger
+
+        logger = new_logger(level=config.base.log_level, fmt=config.base.log_format)
     genesis = GenesisDoc.from_file(config.base.genesis_path())
     if config.base.priv_validator_laddr:
         from cometbft_tpu.privval.signer import (
@@ -416,6 +435,16 @@ def default_new_node(config: Config, logger=None, app=None) -> Node:
             config.base.priv_validator_key_path(),
             config.base.priv_validator_state_path(),
         )
-    if app is None:
-        app = KVStoreApplication()
-    return Node(config, genesis, pv, LocalClientCreator(app), logger)
+    if app is not None:
+        creator = LocalClientCreator(app)
+    elif config.base.proxy_app in ("kvstore", "persistent_kvstore"):
+        creator = LocalClientCreator(KVStoreApplication())
+    elif config.base.proxy_app == "noop":
+        from cometbft_tpu.abci import types as abci_types
+
+        creator = LocalClientCreator(abci_types.Application())
+    else:
+        from cometbft_tpu.abci.client import SocketClientCreator
+
+        creator = SocketClientCreator(config.base.proxy_app)
+    return Node(config, genesis, pv, creator, logger)

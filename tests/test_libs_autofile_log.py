@@ -1,0 +1,80 @@
+"""autofile Group rotation (reference: libs/autofile/group.go) and the
+structured logger (libs/log)."""
+
+import io
+import json
+import os
+
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.libs.autofile import Group
+from cometbft_tpu.libs.log import NopLogger, new_logger
+
+
+def test_group_rotation_and_reader(tmp_path):
+    head = str(tmp_path / "wal")
+    g = Group(head, head_size_limit=100)
+    for i in range(20):
+        g.write(b"%02d" % i * 10)  # 20 bytes per record
+        g.flush_and_sync()
+        g.maybe_rotate()
+    assert g.chunk_indices(), "head must have rotated at least once"
+    # Reader returns the full byte stream oldest-first.
+    with g.reader() as r:
+        data = r.read(10**6)
+    assert data == b"".join(b"%02d" % i * 10 for i in range(20))
+    g.close()
+
+
+def test_group_total_size_prunes_oldest(tmp_path):
+    head = str(tmp_path / "wal")
+    g = Group(head, head_size_limit=50, total_size_limit=200)
+    for i in range(40):
+        g.write(b"x" * 25)
+        g.flush_and_sync()
+        g.maybe_rotate()
+    idx = g.chunk_indices()
+    total = sum(os.path.getsize(f"{head}.{i:03d}") for i in idx) + os.path.getsize(head)
+    assert total <= 250, f"pruning failed: {total} bytes in {len(idx)} chunks"
+    assert idx[0] > 0, "oldest chunks must have been deleted"
+    g.close()
+
+
+def test_wal_survives_rotation(tmp_path):
+    """EndHeight markers in ROTATED chunks are still found by catchup."""
+    wal = WAL(str(tmp_path / "cs.wal"), head_size_limit=256)
+    wal.start()
+    for h in range(1, 30):
+        wal.write_sync(EndHeightMessage(h))
+    assert wal.group.chunk_indices(), "WAL must have rotated"
+    assert wal.has_end_height(1), "marker in the oldest rotated chunk"
+    assert wal.has_end_height(29)
+    msgs, saw = wal.catchup_scan(29, 1)
+    assert msgs == [] and saw
+    wal.stop()
+
+
+def test_logger_plain_and_json_and_filter():
+    buf = io.StringIO()
+    log = new_logger(buf, fmt="plain", level="info").with_(module="consensus")
+    log.debug("hidden", h=1)
+    log.info("enterNewRound", h=5, r=0)
+    out = buf.getvalue()
+    assert "hidden" not in out
+    assert "enterNewRound" in out and "module=consensus" in out and "h=5" in out
+
+    buf = io.StringIO()
+    jlog = new_logger(buf, fmt="json", level="debug")
+    jlog.error("bad thing", err="boom", raw=b"\x01\x02")
+    rec = json.loads(buf.getvalue())
+    assert rec["level"] == "E" and rec["err"] == "boom" and rec["raw"] == "0102"
+
+    buf = io.StringIO()
+    flog = new_logger(
+        buf, level="error", module_levels={"statesync": "debug"}
+    )
+    flog.with_(module="p2p").info("quiet", x=1)
+    flog.with_(module="statesync").debug("loud", y=2)
+    out = buf.getvalue()
+    assert "quiet" not in out and "loud" in out
+
+    NopLogger().info("never", anything=1)  # must not raise
