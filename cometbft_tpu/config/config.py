@@ -11,7 +11,10 @@ class BaseConfig:
     """config/config.go:187-320 BaseConfig."""
 
     root_dir: str = ""
-    proxy_app: str = "tcp://127.0.0.1:26658"
+    # The reference defaults to tcp://127.0.0.1:26658 (an external app);
+    # here the in-process kvstore is the default so `init` + `start` work
+    # standalone — set a socket address to run the app out of process.
+    proxy_app: str = "kvstore"
     moniker: str = "anonymous"
     block_sync: bool = True
     db_backend: str = "sqlite"
