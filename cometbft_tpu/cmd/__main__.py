@@ -20,6 +20,20 @@ def _default_home() -> str:
     return os.environ.get("CMTHOME", os.path.expanduser("~/.cometbft_tpu"))
 
 
+def _load_config(home: str):
+    """default_config + config.toml (if present) + CMT_* env overrides —
+    the reference's viper layering (cmd/cometbft/main.go ParseConfig)."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.config.toml import apply_env_overrides, load_toml
+
+    cfg = default_config()
+    toml_path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(toml_path):
+        cfg = load_toml(toml_path, cfg)
+    cfg.set_root(home)
+    return apply_env_overrides(cfg)
+
+
 def cmd_version(args) -> int:
     from cometbft_tpu.version import VERSION
 
@@ -53,6 +67,12 @@ def cmd_init(args) -> int:
         doc.save_as(genesis_path)
         print(f"Generated genesis file: {genesis_path}")
     _write_node_key(cfg.base.node_key_path())
+    toml_path = os.path.join(home, "config", "config.toml")
+    if not os.path.exists(toml_path):
+        from cometbft_tpu.config.toml import write_config_file
+
+        write_config_file(toml_path, cfg)
+        print(f"Generated config file: {toml_path}")
     print(f"Initialized node in {home}")
     return 0
 
@@ -78,10 +98,9 @@ def _write_node_key(path: str) -> None:
 
 def cmd_start(args) -> int:
     """cmd/cometbft/commands/run_node.go: run one node until interrupted."""
-    from cometbft_tpu.config import default_config
     from cometbft_tpu.node import default_new_node
 
-    cfg = default_config().set_root(args.home)
+    cfg = _load_config(args.home)
     if args.rpc_laddr:
         cfg.rpc.laddr = args.rpc_laddr
     node = default_new_node(cfg)
@@ -217,10 +236,9 @@ def cmd_light(args) -> int:
 
 
 def cmd_show_validator(args) -> int:
-    from cometbft_tpu.config import default_config
     from cometbft_tpu.privval import FilePV
 
-    cfg = default_config().set_root(args.home)
+    cfg = _load_config(args.home)
     pv = FilePV.load(
         cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
     )
@@ -234,9 +252,7 @@ def cmd_show_validator(args) -> int:
 
 
 def cmd_show_node_id(args) -> int:
-    from cometbft_tpu.config import default_config
-
-    cfg = default_config().set_root(args.home)
+    cfg = _load_config(args.home)
     with open(cfg.base.node_key_path()) as f:
         d = json.load(f)
     from cometbft_tpu.crypto import ed25519
@@ -266,13 +282,12 @@ def cmd_gen_validator(args) -> int:
 
 def cmd_rollback(args) -> int:
     """cmd rollback (state/rollback.go): undo one height of state."""
-    from cometbft_tpu.config import default_config
     from cometbft_tpu.libs.db import new_db
     from cometbft_tpu.state.rollback import rollback_state
     from cometbft_tpu.state.store import StateStore
     from cometbft_tpu.store import BlockStore
 
-    cfg = default_config().set_root(args.home)
+    cfg = _load_config(args.home)
     state_store = StateStore(new_db("state", cfg.base.db_backend, cfg.base.db_path()))
     block_store = BlockStore(new_db("blockstore", cfg.base.db_backend, cfg.base.db_path()))
     height, app_hash = rollback_state(state_store, block_store)
@@ -288,6 +303,159 @@ def cmd_reset_state(args) -> int:
         shutil.rmtree(data)
     os.makedirs(data, exist_ok=True)
     print(f"Removed all blockchain data in {data}")
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    """cmd gen_node_key.go: print a fresh node key (and persist if absent)."""
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_gen(cfg.base.node_key_path())
+    print(nk.id)
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """cmd inspect (inspect/inspect.go): read-only RPC over a stopped node's
+    data directory."""
+    from cometbft_tpu.inspect import Inspector
+
+    cfg = _load_config(args.home)
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    ins = Inspector(cfg)
+    ins.start()
+    print(f"inspect RPC on http://127.0.0.1:{ins.port} (read-only)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        ins.stop()
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """cmd compact_goleveldb.go analog: compact every data-dir database."""
+    from cometbft_tpu.libs.db import new_db
+
+    cfg = _load_config(args.home)
+    if cfg.base.db_backend == "memdb":
+        print("memdb backend: nothing to compact")
+        return 0
+    for name in ("blockstore", "state", "tx_index", "block_index", "evidence"):
+        db = new_db(name, cfg.base.db_backend, cfg.base.db_path())
+        db.compact()
+        print(f"compacted {name}")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """cmd reindex_event.go: rebuild tx + block indexes from the block store
+    and the persisted ABCI responses."""
+    from cometbft_tpu.libs.db import new_db
+    from cometbft_tpu.state import StateStore
+    from cometbft_tpu.state.execution import decode_responses
+    from cometbft_tpu.state.txindex import KVBlockIndexer, KVTxIndexer
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.types.events import _abci_events_to_attrs
+
+    cfg = _load_config(args.home)
+    db_dir = cfg.base.db_path()
+    block_store = BlockStore(new_db("blockstore", cfg.base.db_backend, db_dir))
+    state_store = StateStore(new_db("state", cfg.base.db_backend, db_dir))
+    tx_indexer = KVTxIndexer(new_db("tx_index", cfg.base.db_backend, db_dir))
+    block_indexer = KVBlockIndexer(new_db("block_index", cfg.base.db_backend, db_dir))
+    start = args.start_height or max(block_store.base(), 1)
+    end = args.end_height or block_store.height()
+    if end < start:
+        print(f"nothing to reindex (base {start}, height {end})")
+        return 1
+    n = 0
+    for h in range(start, end + 1):
+        block = block_store.load_block(h)
+        raw = state_store.load_abci_responses(h)
+        if block is None or raw is None:
+            continue
+        resp = decode_responses(raw)
+        begin, end_blk = resp["begin_block"], resp["end_block"]
+        block_indexer.index(
+            h, _abci_events_to_attrs(list(begin.events) + list(end_blk.events))
+        )
+        for i, tx in enumerate(block.data.txs):
+            res = resp["deliver_txs"][i]
+            tx_indexer.index(h, i, tx, res, _abci_events_to_attrs(res.events))
+        n += 1
+    print(f"reindexed {n} blocks ({start}..{end})")
+    return 0
+
+
+def cmd_replay(args, console: bool = False) -> int:
+    """cmd replay.go / replay_console.go: re-apply the WAL tail for the
+    latest height against the app (through the normal handshake machinery),
+    optionally stepping message-by-message."""
+    from cometbft_tpu.consensus.wal import WAL
+    from cometbft_tpu.node import default_new_node
+
+    cfg = _load_config(args.home)
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    wal_path = cfg.consensus.wal_path()
+    if console and os.path.exists(wal_path):
+        wal = WAL(wal_path)
+        count = 0
+        for tm in wal.iter_messages():
+            count += 1
+            print(f"#{count}: {type(tm.msg).__name__} {tm.msg}")
+            try:
+                input("> press enter to continue (ctrl-d to finish)...")
+            except EOFError:
+                break
+        wal.stop()
+    # The handshake inside Node construction IS the replay (replay.go
+    # height-case analysis + WAL catchup).
+    node = default_new_node(cfg)
+    h = node.block_store.height()
+    node.stop()
+    print(f"replay done; store height {h}")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """cmd debug kill/dump (cmd/cometbft/commands/debug): collect a node's
+    status/net_info/consensus state + config into a debug archive; `kill`
+    also terminates the process."""
+    import urllib.request
+    import zipfile
+
+    def fetch(method):
+        url = f"{args.rpc_laddr.replace('tcp://', 'http://')}"
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": {}}
+        ).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.read()
+
+    os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+    with zipfile.ZipFile(args.output, "w") as z:
+        for method in ("status", "net_info", "consensus_state", "dump_consensus_state"):
+            try:
+                z.writestr(f"{method}.json", fetch(method))
+            except Exception as e:
+                z.writestr(f"{method}.err", str(e))
+        cfg_path = os.path.join(args.home, "config")
+        if os.path.isdir(cfg_path):
+            for name in os.listdir(cfg_path):
+                p = os.path.join(cfg_path, name)
+                if os.path.isfile(p) and "priv_validator_key" not in name:
+                    z.write(p, f"config/{name}")
+    print(f"wrote debug archive {args.output}")
+    if args.debug_cmd == "kill":
+        os.kill(int(args.pid), 15)
+        print(f"sent SIGTERM to {args.pid}")
     return 0
 
 
@@ -357,6 +525,20 @@ def main(argv=None) -> int:
     sub.add_parser("rollback")
     sub.add_parser("reset-state")
     sub.add_parser("unsafe-reset-all")
+    sub.add_parser("gen-node-key")
+    sp = sub.add_parser("inspect")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sub.add_parser("compact-db")
+    sp = sub.add_parser("reindex-event")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sub.add_parser("replay")
+    sub.add_parser("replay-console")
+    sp = sub.add_parser("debug")
+    sp.add_argument("debug_cmd", choices=["kill", "dump"])
+    sp.add_argument("pid", nargs="?", default="0")
+    sp.add_argument("--output", default="debug.zip")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="tcp://127.0.0.1:26657")
     sp = sub.add_parser("testnet")
     sp.add_argument("--validators", type=int, default=4)
     sp.add_argument("--output-dir", default="./mytestnet")
@@ -376,6 +558,13 @@ def main(argv=None) -> int:
         "reset-state": cmd_reset_state,
         "unsafe-reset-all": cmd_reset_state,
         "testnet": cmd_testnet,
+        "gen-node-key": cmd_gen_node_key,
+        "inspect": cmd_inspect,
+        "compact-db": cmd_compact_db,
+        "reindex-event": cmd_reindex_event,
+        "replay": cmd_replay,
+        "replay-console": lambda a: cmd_replay(a, console=True),
+        "debug": cmd_debug,
     }
     if args.command is None:
         p.print_help()

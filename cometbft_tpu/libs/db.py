@@ -34,6 +34,10 @@ class DB:
     def delete_sync(self, key: bytes) -> None:
         self.delete(key)
 
+    def compact(self) -> None:
+        """Reclaim space (cmd compact-db; goleveldb CompactRange in the
+        reference — VACUUM for the sqlite backend, no-op in memory)."""
+
     def iterator(self, start: bytes | None = None, end: bytes | None = None):
         """Ascending iterator over [start, end) as (key, value) pairs."""
         raise NotImplementedError
@@ -157,6 +161,12 @@ class SQLiteDB(DB):
     def delete(self, key: bytes) -> None:
         with self._mtx:
             self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def compact(self) -> None:
+        with self._mtx:
+            self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            self._conn.execute("VACUUM")
             self._conn.commit()
 
     def iterator(self, start=None, end=None):

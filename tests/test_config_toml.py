@@ -1,0 +1,88 @@
+"""config.toml render/load + CMT_* env overrides (reference: config/toml.go
+WriteConfigFile + viper layering)."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.config import Config, default_config
+from cometbft_tpu.config.toml import (
+    apply_env_overrides,
+    load_toml,
+    render_toml,
+    write_config_file,
+)
+
+
+def test_render_load_roundtrip(tmp_path):
+    cfg = default_config()
+    cfg.base.moniker = "bench-node"
+    cfg.p2p.seeds = "aa@1.2.3.4:26656"
+    cfg.consensus.timeout_commit = 2.5
+    cfg.statesync.enable = True
+    cfg.statesync.rpc_servers = ("http://a:26657", "http://b:26657")
+    path = str(tmp_path / "config.toml")
+    write_config_file(path, cfg)
+    loaded = load_toml(path)
+    assert loaded.base.moniker == "bench-node"
+    assert loaded.p2p.seeds == "aa@1.2.3.4:26656"
+    assert loaded.consensus.timeout_commit == 2.5
+    assert loaded.statesync.enable is True
+    assert loaded.statesync.rpc_servers == ("http://a:26657", "http://b:26657")
+    # untouched defaults survive
+    assert loaded.mempool.size == Config().mempool.size
+
+
+def test_load_rejects_unknown_keys(tmp_path):
+    path = str(tmp_path / "config.toml")
+    with open(path, "w") as f:
+        f.write('[p2p]\nladdr = "tcp://0.0.0.0:1"\ntypo_key = 3\n')
+    with pytest.raises(ValueError, match="unknown config key p2p.typo_key"):
+        load_toml(path)
+
+
+def test_env_overrides_take_precedence():
+    cfg = default_config()
+    env = {
+        "CMT_BASE_LOG_LEVEL": "debug",
+        "CMT_P2P_SEEDS": "x@1.1.1.1:1,y@2.2.2.2:2",
+        "CMT_RPC_LADDR": "tcp://0.0.0.0:9999",
+        "CMT_CONSENSUS_TIMEOUT_COMMIT": "0.75",
+        "CMT_STATESYNC_ENABLE": "true",
+        "CMT_TX_INDEX_INDEXER": "null",
+        "UNRELATED": "zzz",
+    }
+    apply_env_overrides(cfg, env)
+    assert cfg.base.log_level == "debug"
+    assert cfg.p2p.seeds == "x@1.1.1.1:1,y@2.2.2.2:2"
+    assert cfg.rpc.laddr == "tcp://0.0.0.0:9999"
+    assert cfg.consensus.timeout_commit == 0.75
+    assert cfg.statesync.enable is True
+    assert cfg.tx_index.indexer == "null"
+
+
+def test_cli_init_writes_and_start_reads(tmp_path):
+    """init generates config.toml; _load_config layers it + env."""
+    from cometbft_tpu.cmd.__main__ import _load_config, main as cli
+
+    home = str(tmp_path / "home")
+    assert cli(["--home", home, "init", "--chain-id", "toml-chain"]) == 0
+    toml_path = os.path.join(home, "config", "config.toml")
+    assert os.path.exists(toml_path)
+    with open(toml_path, "a") as f:
+        f.write("\n[consensus]\ntimeout_commit = 9.5\n")
+    # tomllib forbids duplicate sections -> rewrite properly instead
+    with open(toml_path) as f:
+        body = f.read()
+    body = body.replace("timeout_commit = 1.0", "timeout_commit = 9.5", 1)
+    body = body[: body.rindex("\n[consensus]")]
+    with open(toml_path, "w") as f:
+        f.write(body)
+    cfg = _load_config(home)
+    assert cfg.consensus.timeout_commit == 9.5
+    os.environ["CMT_CONSENSUS_TIMEOUT_COMMIT"] = "3.25"
+    try:
+        cfg = _load_config(home)
+        assert cfg.consensus.timeout_commit == 3.25
+    finally:
+        del os.environ["CMT_CONSENSUS_TIMEOUT_COMMIT"]
