@@ -273,6 +273,26 @@ class Node:
 
         if self.metrics_server is not None:
             self.metrics_server.start()
+        if self.config.rpc.pprof_laddr:
+            from cometbft_tpu.libs.pprof import PprofServer
+
+            host, _, port = self.config.rpc.pprof_laddr.split("://")[-1].rpartition(":")
+            self.pprof_server = PprofServer(
+                host or "127.0.0.1",
+                int(port),
+                trace_dir=os.path.join(self.config.base.root_dir or ".", "jax-trace"),
+            )
+            self.pprof_server.start()
+        if os.environ.get("CMTPU_WATCHDOG"):
+            from cometbft_tpu.libs.deadlock import Watchdog
+
+            self.watchdog = Watchdog(
+                lambda: self.consensus_state.rs.height,
+                stall_after=float(os.environ["CMTPU_WATCHDOG"]),
+                logger=self.logger,
+                on_stall=lambda report: print(report),
+            )
+            self.watchdog.start()
 
         if self._state_sync and self.switch is not None:
             threading.Thread(
@@ -310,6 +330,10 @@ class Node:
 
     def stop(self) -> None:
         self.consensus_state.stop()
+        if getattr(self, "pprof_server", None) is not None:
+            self.pprof_server.stop()
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         if self.switch is not None:
