@@ -47,17 +47,23 @@ def bucket_for(n: int) -> int:
 def verify_core(y_a, sign_a, y_r, sign_r, s_digits, k_digits):
     """Pure jittable core: limbs/signed digits in, bool[N] out. The A and R
     decompressions ride ONE width-2N pass (lane-stacked) — same op count in
-    half the program."""
+    half the program. Straight-line sections compile with the COMPACT field
+    multiply (decompression's inversion chain is ~280 muls of ~3,300 total:
+    a planar lowering there would double compile time for a few percent of
+    runtime); the loop-rolled window ladder keeps the planar lowering."""
     n = y_a.shape[1]
-    y2 = jnp.concatenate([y_a, y_r], axis=1)
-    sg2 = jnp.concatenate([sign_a, sign_r])
-    pt, ok = ed.decompress(y2, sg2)
-    a = tuple(c[:, :n] for c in pt)
-    r = tuple(c[:, n:] for c in pt)
-    acc = ed.windowed_double_base_mult(s_digits, k_digits, ed.point_neg(a))
-    acc = ed.point_add(acc, ed.point_neg(r))
-    acc = ed.point_double(ed.point_double(ed.point_double(acc)))
-    return ok[:n] & ok[n:] & ed.point_is_identity(acc)
+    with fe.compact_scope():
+        y2 = jnp.concatenate([y_a, y_r], axis=1)
+        sg2 = jnp.concatenate([sign_a, sign_r])
+        pt, ok = ed.decompress(y2, sg2)
+        a = tuple(c[:, :n] for c in pt)
+        r = tuple(c[:, n:] for c in pt)
+        neg_a = ed.point_neg(a)
+    acc = ed.windowed_double_base_mult(s_digits, k_digits, neg_a)
+    with fe.compact_scope():
+        acc = ed.point_add(acc, ed.point_neg(r))
+        acc = ed.point_double(ed.point_double(ed.point_double(acc)))
+        return ok[:n] & ok[n:] & ed.point_is_identity(acc)
 
 
 @functools.lru_cache(maxsize=None)

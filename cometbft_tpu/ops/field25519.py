@@ -32,6 +32,9 @@ of crypto/ed25519/ed25519.go:27-29).
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
+
 import numpy as np
 
 import jax
@@ -185,6 +188,7 @@ def _mul_compact(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
 
 _PLANAR: bool | None = None
+_SCOPE = threading.local()
 
 
 def _use_planar() -> bool:
@@ -194,9 +198,26 @@ def _use_planar() -> bool:
     once per process — mixed-backend processes would need per-trace plumbing
     this framework doesn't require."""
     global _PLANAR
+    if getattr(_SCOPE, "compact", False):
+        return False
     if _PLANAR is None:
         _PLANAR = jax.default_backend() != "cpu"
     return _PLANAR
+
+
+@contextmanager
+def compact_scope():
+    """Force the compact lowering inside this trace region. Planar multiplies
+    cost ~1.5k HLO ops each; STRAIGHT-LINE sections (decompression's
+    inversion chain, final adds) would dominate compile time for a marginal
+    runtime share, so the verify kernel scopes planar to its loop-rolled
+    ladder and compiles everything else compact."""
+    prev = getattr(_SCOPE, "compact", False)
+    _SCOPE.compact = True
+    try:
+        yield
+    finally:
+        _SCOPE.compact = prev
 
 
 def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
