@@ -253,3 +253,18 @@ def test_node_with_out_of_process_app(kvstore_proc):
     except Exception:
         raised = True
     assert raised, "empty-store node against tall app must fail the handshake"
+
+
+def test_abci_cli_batch_commands(kvstore_proc, capsys):
+    """abci-cli against the out-of-process kvstore (abci-cli.go shape)."""
+    from cometbft_tpu.abci.cli import main as cli_main
+
+    assert cli_main(["--addr", kvstore_proc, "echo", "ping"]) == 0
+    assert cli_main(["--addr", kvstore_proc, "deliver_tx", "cli=works"]) == 0
+    assert cli_main(["--addr", kvstore_proc, "commit"]) == 0
+    assert cli_main(["--addr", kvstore_proc, "query", "cli"]) == 0
+    out = capsys.readouterr().out
+    assert "message: ping" in out
+    assert "0x" in out  # commit app hash
+    assert "value: 0x" + b"works".hex().upper() in out
+    assert cli_main(["--addr", kvstore_proc, "bogus"]) == 1
