@@ -89,6 +89,10 @@ class P2PConfig:
     pex: bool = True
     seed_mode: bool = False
     private_peer_ids: str = ""
+    test_fuzz: bool = False  # wrap connections in FuzzedConn (p2p/fuzz.go)
+    test_fuzz_mode: str = "delay"
+    test_fuzz_max_delay: float = 0.2
+    test_fuzz_prob_drop_rw: float = 0.2
     allow_duplicate_ip: bool = False
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0

@@ -56,12 +56,20 @@ class PeerState:
             self._sent_parts.add(key)
             return True
 
+    def unmark_part_sent(self, height: int, index: int) -> None:
+        with self._mtx:
+            self._sent_parts.discard((height, index))
+
     def mark_vote_sent(self, key) -> bool:
         with self._mtx:
             if key in self._sent_votes:
                 return False
             self._sent_votes.add(key)
             return True
+
+    def unmark_vote_sent(self, key) -> None:
+        with self._mtx:
+            self._sent_votes.discard(key)
 
 
 class ConsensusReactor(Reactor):
@@ -117,13 +125,20 @@ class ConsensusReactor(Reactor):
                 ps.mark_vote_sent((msg.height, msg.round, msg.type, msg.index))
             elif isinstance(msg, cmsg.VoteSetMaj23Message):
                 # reactor.go:300-340: record the claimed majority, then tell
-                # the peer which of those votes we ALREADY have.
+                # the peer which of those votes we ALREADY have. A conflicting
+                # claim is LOGGED, not punished: our state reads are lock-free
+                # snapshots, so a round race can mislabel an honest claim and
+                # killing the peer for it degrades the gossip mesh (the
+                # reference stops the peer; deliberate softening).
                 rs = self.cs.rs
                 if msg.height != rs.height or rs.votes is None:
                     return
-                self.cs.rs.votes.set_peer_maj23(
-                    msg.round, msg.type, peer.id, msg.block_id
-                )
+                try:
+                    self.cs.rs.votes.set_peer_maj23(
+                        msg.round, msg.type, peer.id, msg.block_id
+                    )
+                except Exception:
+                    return
                 from cometbft_tpu.types.block import PREVOTE_TYPE
 
                 vote_set = (
@@ -147,7 +162,7 @@ class ConsensusReactor(Reactor):
             # The peer's answer to our VoteSetMaj23: which of those votes it
             # already has — gossip skips them (reactor.go:377-402).
             if isinstance(msg, cmsg.VoteSetBitsMessage) and ps and msg.votes:
-                for i in range(msg.votes.size()):
+                for i in range(msg.votes.size):
                     if msg.votes.get_index(i):
                         ps.mark_vote_sent((msg.height, msg.round, msg.type, i))
 
@@ -208,27 +223,32 @@ class ConsensusReactor(Reactor):
             rs = self.cs.rs
             if rs.votes is None or self.switch is None:
                 continue
+            # Snapshot (height, round) ONCE: reading rs.round again per claim
+            # races the state machine — a round advance mid-loop would tag a
+            # majority with the wrong round, and the receiver treats
+            # conflicting claims from one peer as misbehavior.
+            height, round_ = rs.height, rs.round
             claims = []
             for vtype, vote_set in (
-                (PREVOTE_TYPE, rs.votes.prevotes(rs.round)),
-                (PRECOMMIT_TYPE, rs.votes.precommits(rs.round)),
+                (PREVOTE_TYPE, rs.votes.prevotes(round_)),
+                (PRECOMMIT_TYPE, rs.votes.precommits(round_)),
             ):
                 if vote_set is None:
                     continue
                 block_id, ok = vote_set.two_thirds_majority()
                 if ok:
-                    claims.append((vtype, rs.round, block_id))
+                    claims.append((vtype, block_id))
             if not claims:
                 continue
             for ps in list(self.peer_states.values()):
-                if ps.height != rs.height:
+                if ps.height != height:
                     continue
-                for vtype, round_, block_id in claims:
+                for vtype, block_id in claims:
                     ps.peer.try_send(
                         CONSENSUS_STATE_CHANNEL,
                         cmsg.encode_consensus_message(
                             cmsg.VoteSetMaj23Message(
-                                height=rs.height, round=round_, type=vtype,
+                                height=height, round=round_, type=vtype,
                                 block_id=block_id,
                             )
                         ),
@@ -257,14 +277,18 @@ class ConsensusReactor(Reactor):
             for i in range(block_meta.block_id.part_set_header.total):
                 if ps.mark_part_sent(ps.height, i):
                     part = self.cs.block_store.load_block_part(ps.height, i)
-                    if part is not None:
-                        ps.peer.try_send(
-                            CONSENSUS_DATA_CHANNEL,
-                            cmsg.encode_consensus_message(
-                                cmsg.BlockPartMessage(ps.height, ps.round, part)
-                            ),
-                        )
+                    # A full send queue drops the message: un-mark so the
+                    # next gossip pass retries instead of losing the part
+                    # forever (liveness under backpressure).
+                    if part is not None and ps.peer.try_send(
+                        CONSENSUS_DATA_CHANNEL,
+                        cmsg.encode_consensus_message(
+                            cmsg.BlockPartMessage(ps.height, ps.round, part)
+                        ),
+                    ):
                         sent = True
+                    else:
+                        ps.unmark_part_sent(ps.height, i)
             seen_commit = self.cs.block_store.load_seen_commit(ps.height)
             if seen_commit is not None:
                 from cometbft_tpu.types.vote import Vote
@@ -285,11 +309,13 @@ class ConsensusReactor(Reactor):
                         validator_index=idx,
                         signature=cs_sig.signature,
                     )
-                    ps.peer.try_send(
+                    if ps.peer.try_send(
                         CONSENSUS_VOTE_CHANNEL,
                         cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
-                    )
-                    sent = True
+                    ):
+                        sent = True
+                    else:
+                        ps.unmark_vote_sent(key)
             return sent
         # Same height: re-send our proposal/parts and known votes they lack.
         if ps.height == rs.height:
@@ -297,22 +323,26 @@ class ConsensusReactor(Reactor):
             if rs.proposal is not None and ps.round == rs.round:
                 key = ("proposal", rs.height, rs.round)
                 if ps.mark_vote_sent(key):
-                    ps.peer.try_send(
+                    if ps.peer.try_send(
                         CONSENSUS_DATA_CHANNEL,
                         cmsg.encode_consensus_message(cmsg.ProposalMessage(rs.proposal)),
-                    )
-                    sent = True
+                    ):
+                        sent = True
+                    else:
+                        ps.unmark_vote_sent(key)
                 if rs.proposal_block_parts is not None:
                     for i in range(rs.proposal_block_parts.total):
                         part = rs.proposal_block_parts.get_part(i)
                         if part is not None and ps.mark_part_sent(rs.height, i):
-                            ps.peer.try_send(
+                            if ps.peer.try_send(
                                 CONSENSUS_DATA_CHANNEL,
                                 cmsg.encode_consensus_message(
                                     cmsg.BlockPartMessage(rs.height, rs.round, part)
                                 ),
-                            )
-                            sent = True
+                            ):
+                                sent = True
+                            else:
+                                ps.unmark_part_sent(rs.height, i)
             if rs.votes is not None:
                 for vote_set in (
                     rs.votes.prevotes(rs.round),
@@ -323,10 +353,12 @@ class ConsensusReactor(Reactor):
                     for vote in vote_set.list_votes():
                         key = (vote.height, vote.round, vote.type, vote.validator_index)
                         if ps.mark_vote_sent(key):
-                            ps.peer.try_send(
+                            if ps.peer.try_send(
                                 CONSENSUS_VOTE_CHANNEL,
                                 cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
-                            )
-                            sent = True
+                            ):
+                                sent = True
+                            else:
+                                ps.unmark_vote_sent(key)
             return sent
         return False

@@ -200,9 +200,18 @@ class Node:
                 network=genesis_doc.chain_id,
                 moniker=config.base.moniker,
             )
+            fuzz_config = None
+            if config.p2p.test_fuzz:
+                from cometbft_tpu.p2p.fuzz import FuzzConnConfig
+
+                fuzz_config = FuzzConnConfig(
+                    mode=config.p2p.test_fuzz_mode,
+                    max_delay=config.p2p.test_fuzz_max_delay,
+                    prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
+                )
             self.switch = Switch(
                 self.node_info,
-                MultiplexTransport(self.node_info, self.node_key),
+                MultiplexTransport(self.node_info, self.node_key, fuzz_config),
                 config=config.p2p,
             )
             self.consensus_reactor = ConsensusReactor(self.consensus_state)

@@ -15,6 +15,7 @@ import struct
 import threading
 import time
 
+from cometbft_tpu.libs import flowrate
 from cometbft_tpu.wire import proto as wire
 
 DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
@@ -51,30 +52,6 @@ class _Channel:
         self.recving = b""
 
 
-class _TokenBucket:
-    """libs/flowrate analog: byte-rate throttling."""
-
-    def __init__(self, rate: int):
-        self.rate = rate
-        self.allowance = float(rate)
-        self.last = time.monotonic()
-        self._mtx = threading.Lock()
-
-    def limit(self, n: int) -> None:
-        if self.rate <= 0:
-            return
-        with self._mtx:
-            now = time.monotonic()
-            self.allowance = min(
-                self.rate, self.allowance + (now - self.last) * self.rate
-            )
-            self.last = now
-            self.allowance -= n
-            if self.allowance < 0:
-                time.sleep(-self.allowance / self.rate)
-                self.allowance = 0
-
-
 class MConnection:
     """conn/connection.go:78 MConnection."""
 
@@ -93,8 +70,12 @@ class MConnection:
         self.on_receive = on_receive
         self.on_error = on_error
         self.max_payload = max_packet_msg_payload_size
-        self._send_limiter = _TokenBucket(send_rate)
-        self._recv_limiter = _TokenBucket(recv_rate)
+        # libs/flowrate Monitors: throttling + rate telemetry per direction
+        # (conn/connection.go sendMonitor/recvMonitor).
+        self.send_monitor = flowrate.Monitor()
+        self.recv_monitor = flowrate.Monitor()
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
         self._send_signal = threading.Event()
         self._running = False
         self._pong_pending = False
@@ -201,7 +182,8 @@ class MConnection:
 
     def _write_packet(self, packet_fields: bytes) -> None:
         framed = wire.length_delimited(packet_fields)
-        self._send_limiter.limit(len(framed))
+        self.send_monitor.limit(len(framed), self._send_rate)
+        self.send_monitor.update(len(framed))
         self._conn.sendall(framed) if hasattr(self._conn, "sendall") else self._conn.write(framed)
 
     # -- receiving (conn/connection.go recvRoutine) ---------------------------
@@ -250,7 +232,8 @@ class MConnection:
         ln, _ = wire.decode_uvarint(hdr, 0)
         if ln > MAX_MSG_SIZE:
             raise ValueError("packet too large")
-        self._recv_limiter.limit(ln)
+        self.recv_monitor.limit(ln, self._recv_rate)
+        self.recv_monitor.update(ln)
         return self._read_exact(ln)
 
     def _read_exact(self, n: int) -> bytes:

@@ -20,6 +20,7 @@ peers (SURVEY.md §7 hard part 5 defers exact transcript interop).
 
 from __future__ import annotations
 
+import socket
 import hashlib
 import hmac
 import os
@@ -185,6 +186,13 @@ class SecretConnection:
         return out
 
     def close(self) -> None:
+        # shutdown() before close(): close() alone does NOT wake a thread
+        # blocked in recv() on another thread's stack (the fd stays open in
+        # the kernel until the recv returns) — the recv loop would leak.
+        try:
+            self._conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._conn.close()
         except Exception:

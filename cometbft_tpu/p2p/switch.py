@@ -186,6 +186,18 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """switch.go StopPeerForError."""
+        import os
+
+        if os.environ.get("CMTPU_P2P_DEBUG"):
+            import sys
+            import traceback
+
+            print(
+                f"[p2p] stop_peer_for_error {peer.id[:8]}: {reason!r}",
+                file=sys.stderr, flush=True,
+            )
+            if isinstance(reason, Exception):
+                traceback.print_exception(reason, file=sys.stderr)
         with self._mtx:
             existing = self._peers.pop(peer.id, None)
         if existing is None:

@@ -40,7 +40,8 @@ class UpgradedConn:
 class MultiplexTransport:
     """p2p/transport.go."""
 
-    def __init__(self, node_info: NodeInfo, node_key: NodeKey):
+    def __init__(self, node_info: NodeInfo, node_key: NodeKey, fuzz_config=None):
+        self.fuzz_config = fuzz_config
         self.node_info = node_info
         self.node_key = node_key
         self._listener: socket.socket | None = None
@@ -102,6 +103,10 @@ class MultiplexTransport:
 
     def _upgrade(self, sock: socket.socket, outbound: bool, remote: str) -> UpgradedConn:
         sc = SecretConnection(sock, self.node_key.priv_key)
+        # Fuzzing wraps AFTER the secret handshake (documented deviation
+        # from fuzz.go's raw-conn wrap: with drop-mode probabilities the
+        # handshake itself would rarely complete; the churn under test is
+        # the message layer + reconnect machinery).
         # NodeInfo swap: length-delimited (transport.go handshake).
         sc.write(wire.length_delimited(self.node_info.encode()))
         their_info = _read_delimited_node_info(sc)
@@ -114,6 +119,10 @@ class MultiplexTransport:
                 f"nodeInfo.ID ({their_info.node_id}) doesn't match authenticated key ({authed_id})"
             )
         sock.settimeout(None)
+        if self.fuzz_config is not None:
+            from cometbft_tpu.p2p.fuzz import FuzzedConn
+
+            sc = FuzzedConn(sc, self.fuzz_config)
         return UpgradedConn(sc, their_info, outbound, remote)
 
     def close(self) -> None:

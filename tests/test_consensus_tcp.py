@@ -84,9 +84,24 @@ def test_consensus_over_tcp(tcp_net):
         assert sw.num_peers() == 2
         cs.start()
     cs0, sw0, nk0, mempool0, app0 = nodes[0]
-    assert cs0.wait_for_height(3, timeout=45), (
-        f"stuck at {cs0.rs.height}/{cs0.rs.round}/{cs0.rs.step}"
-    )
+    if not cs0.wait_for_height(3, timeout=45):
+        lines = []
+        for k, (cs, sw, *_rest) in enumerate(nodes):
+            rs = cs.rs
+            pv_set = rs.votes.prevotes(rs.round) if rs.votes else None
+            pc_set = rs.votes.precommits(rs.round) if rs.votes else None
+            lines.append(
+                f"node{k}: h={rs.height} r={rs.round} step={rs.step} "
+                f"peers={sw.num_peers()} "
+                f"pv={pv_set.bit_array() if pv_set else None} "
+                f"pc={pc_set.bit_array() if pc_set else None} "
+                f"proposal={'y' if rs.proposal else 'n'}"
+            )
+        from cometbft_tpu.libs.pprof import thread_stacks
+
+        with open("/root/repo/.stall_dump.txt", "w") as f:
+            f.write("\n".join(lines) + "\n\n" + thread_stacks())
+        raise AssertionError("stuck: " + " | ".join(lines))
     # Tx gossip: submit on node 2; any proposer should include it.
     nodes[2][3].check_tx(b"tcp=works")
     deadline = time.time() + 45
@@ -98,7 +113,16 @@ def test_consensus_over_tcp(tcp_net):
                 found = True
                 break
         time.sleep(0.25)
-    assert found, "gossiped tx never committed"
+    if not found:
+        diag = " | ".join(
+            f"node{k}: h={cs.rs.height} peers={sw.num_peers()} mempool={mp.size()}"
+            for k, (cs, sw, _nk, mp, _app) in enumerate(nodes)
+        )
+        from cometbft_tpu.libs.pprof import thread_stacks
+
+        with open("/root/repo/.stall_dump.txt", "w") as f:
+            f.write(diag + "\n\n" + thread_stacks())
+        raise AssertionError(f"gossiped tx never committed: {diag}")
     # All nodes agree at height 2.
     h2 = {n[0].block_store.load_block(2).hash() for n in nodes}
     assert len(h2) == 1

@@ -116,3 +116,52 @@ def test_network_mismatch_rejected():
     finally:
         sw1.stop()
         sw2.stop()
+
+
+def test_fuzzed_delay_connection_still_delivers():
+    """p2p/fuzz.go delay mode: IO is jittered but messages arrive; switches
+    built with a FuzzConnConfig transport stay functional."""
+    from cometbft_tpu.p2p.fuzz import FuzzConnConfig
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.node_info import NodeInfo
+    from cometbft_tpu.p2p.reactor import Reactor
+    from cometbft_tpu.p2p.switch import Switch
+    from cometbft_tpu.p2p.transport import MultiplexTransport
+    import threading as _threading
+    import time as _time
+
+    got = _threading.Event()
+
+    class Echo(Reactor):
+        def __init__(self, name):
+            super().__init__(name)
+
+        def get_channels(self):
+            return [ChannelDescriptor(0x77, priority=1, send_queue_capacity=10)]
+
+        def receive(self, chan_id, peer, msg_bytes):
+            if msg_bytes == b"fuzzy":
+                got.set()
+
+    fuzz = FuzzConnConfig(mode="delay", max_delay=0.02, seed=7)
+    sws = []
+    for i in range(2):
+        nk = NodeKey()
+        ni = NodeInfo(node_id=nk.id, network="fuzz-chain", moniker=f"f{i}")
+        sw = Switch(ni, MultiplexTransport(ni, nk, fuzz))
+        sw.add_reactor("ECHO", Echo("ECHO"))
+        sws.append((sw, nk))
+    try:
+        addr = sws[0][0].start("127.0.0.1:0")
+        sws[1][0].start("127.0.0.1:0")
+        peer = sws[1][0].dial_peer(f"{sws[0][1].id}@{addr}")
+        assert peer is not None
+        for _ in range(50):
+            peer.try_send(0x77, b"fuzzy")
+            if got.wait(0.1):
+                break
+        assert got.is_set(), "delayed link must still deliver"
+    finally:
+        for sw, _ in sws:
+            sw.stop()

@@ -26,7 +26,8 @@ def na(i: int, port: int = 26656, ip: str = "8.8.{}.{}") -> NetAddress:
 
 
 def test_addrbook_add_pick_promote():
-    book = AddrBook(strict=True)
+    book = AddrBook(strict=True, key=b"\x07" * 24)
+    book._rand.seed(42)  # deterministic sampling for the bad-addr assertion
     src = na(999)
     for i in range(50):
         assert book.add_address(na(i), src)
