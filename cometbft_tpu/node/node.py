@@ -54,11 +54,17 @@ class Node:
         priv_validator,
         client_creator,
         logger=None,
+        custom_reactors: dict | None = None,
     ):
         self.config = config
         self.genesis_doc = genesis_doc
         self.priv_validator = priv_validator
         self.logger = logger
+        # node/node.go CustomReactors option: name -> Reactor, added to the
+        # switch after the built-ins (same-name entries replace built-ins in
+        # the reference; here extra names only — replacement would need the
+        # channel table rebuilt).
+        self._custom_reactors = custom_reactors or {}
 
         # Storage (node/node.go:147 initDBs).
         db_dir = config.base.db_path()
@@ -257,6 +263,9 @@ class Node:
                 )
                 self.switch.add_reactor("PEX", self.pex_reactor)
 
+            for name, reactor in self._custom_reactors.items():
+                self.switch.add_reactor(name, reactor)
+
         # RPC (node/node.go:392 startRPC).
         self.rpc_server = None
         self._rpc_env = None
@@ -275,10 +284,14 @@ class Node:
                     "p2p listening", module="p2p", addr=self.p2p_laddr,
                     node_id=self.node_key.id,
                 )
-            for addr in self.config.p2p.persistent_peers.split(","):
-                addr = addr.strip()
-                if addr:
-                    self.switch.dial_peer(addr)
+            # Persistent peers ride the switch's backoff redial loop
+            # (switch.go reconnectToPeer): peers that aren't up yet — the
+            # normal case when a testnet launches in parallel — must not
+            # fail OnStart.
+            self.switch.add_persistent_peers(
+                [a.strip() for a in self.config.p2p.persistent_peers.split(",") if a.strip()]
+            )
+            self.switch.dial_persistent_peers()
 
         if self.metrics_server is not None:
             self.metrics_server.start()

@@ -86,9 +86,18 @@ def device_backend(choice: str = "auto") -> VerifyBackend:
         return CpuBackend()
     if choice == "tpu":
         return TpuBackend()
+    # auto: a JAX_PLATFORMS=cpu environment means "no accelerator" without
+    # importing jax at all — the axon PJRT plugin ignores the env var alone
+    # and its init HANGS when the device tunnel is wedged, which would stall
+    # the first commit verification of every CLI node in a CPU deployment.
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want == "cpu":
+        return CpuBackend()
     try:
         import jax
 
+        if want:
+            jax.config.update("jax_platforms", want)
         if any(d.platform != "cpu" for d in jax.devices()):
             return TpuBackend()
     except Exception:
