@@ -71,15 +71,17 @@ class MempoolReactor(Reactor):
             sent_set = self._peer_sent.get(peer.id)
             if sent_set is None:
                 return
-            batch = []
+            batch, keys = [], []
             for mtx in self.mempool.txs_front():
                 k = tx_key(mtx.tx)
                 if k in sent_set or peer.id in mtx.senders:
                     continue
-                # Don't send to peers that are still syncing below the tx's
-                # validation height (reference peer-state height check).
-                sent_set.add(k)
+                keys.append(k)
                 batch.append(mtx.tx)
-            if batch:
-                peer.try_send(MEMPOOL_CHANNEL, encode_txs_message(batch))
+            if batch and peer.try_send(MEMPOOL_CHANNEL, encode_txs_message(batch)):
+                # Mark AFTER a successful enqueue: a full send queue drops
+                # the message, and pre-marking would lose those txs from
+                # gossip forever (same backpressure-liveness rule as the
+                # consensus gossip).
+                sent_set.update(keys)
             time.sleep(0.05)

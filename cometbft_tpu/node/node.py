@@ -345,6 +345,7 @@ class Node:
                 tx_indexer=self.tx_indexer,
                 block_indexer=self.block_indexer,
                 proxy_app_query=self.proxy_app.query,
+                p2p_peers=self.switch,
             )
             self._rpc_env = env
             self.rpc_server = JSONRPCServer(routes(env), host, port)

@@ -625,7 +625,7 @@ def routes(env: Environment) -> dict:
         env.event_bus.unsubscribe_all(ws.remote)
         return {}
 
-    return {
+    table = {
         "health": health,
         "status": status,
         "net_info": net_info,
@@ -658,6 +658,35 @@ def routes(env: Environment) -> dict:
         "unsubscribe": unsubscribe,
         "unsubscribe_all": unsubscribe_all,
     }
+
+    # ---- unsafe dev routes (routes.go AddUnsafeRoutes, rpc/core/dev.go +
+    # net.go UnsafeDialSeeds/UnsafeDialPeers) — only with config.rpc.unsafe.
+    if getattr(getattr(env.config, "rpc", None), "unsafe", False):
+
+        def dial_seeds(seeds=()):
+            if env.p2p_peers is None:
+                raise RPCError(-32603, "p2p layer unavailable", None)
+            for s in seeds:
+                env.p2p_peers.dial_peer(s)
+            return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+        def dial_peers(peers=(), persistent=False, **_kw):
+            if env.p2p_peers is None:
+                raise RPCError(-32603, "p2p layer unavailable", None)
+            for p in peers:
+                if persistent:
+                    env.p2p_peers.add_persistent_peers([p])
+                env.p2p_peers.dial_peer(p)
+            return {"log": "Dialing peers in progress. See /net_info for details"}
+
+        def unsafe_flush_mempool():
+            env.mempool.flush()
+            return {}
+
+        table["dial_seeds"] = dial_seeds
+        table["dial_peers"] = dial_peers
+        table["unsafe_flush_mempool"] = unsafe_flush_mempool
+    return table
 
 
 def _parse_hash(h) -> bytes:
