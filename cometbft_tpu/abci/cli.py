@@ -40,8 +40,14 @@ def _print_resp(resp) -> None:
     print("-> " + "\n-> ".join(pairs or [type(resp).__name__]))
 
 
+_NEEDS_ARG = {"deliver_tx", "check_tx", "query"}
+
+
 def run_command(client: SocketClient, parts: list[str]) -> int:
     cmd, args = parts[0], parts[1:]
+    if cmd in _NEEDS_ARG and not args:
+        print(f"usage: {cmd} <arg>", file=sys.stderr)
+        return 1
     if cmd == "echo":
         _print_resp(client.echo(args[0] if args else ""))
     elif cmd == "info":

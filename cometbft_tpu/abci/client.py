@@ -231,10 +231,17 @@ class SocketClient(Client):
                 req, callback = self._async_queue.pop(0)
             try:
                 res = self._call(req)
-            except Exception:
-                return
+            except Exception as e:
+                # One failed CheckTx (app exception / socket flap) must not
+                # kill the dispatch thread — the mempool would silently stop
+                # admitting txs forever. Deliver an error response and keep
+                # draining.
+                res = abci.ResponseCheckTx(code=1, log=f"abci socket error: {e}")
             if callback is not None:
-                callback(res)
+                try:
+                    callback(res)
+                except Exception:
+                    pass
 
     def echo(self, msg: str):
         return self._call(abci.RequestEcho(message=msg))

@@ -454,8 +454,13 @@ def cmd_debug(args) -> int:
                     z.write(p, f"config/{name}")
     print(f"wrote debug archive {args.output}")
     if args.debug_cmd == "kill":
-        os.kill(int(args.pid), 15)
-        print(f"sent SIGTERM to {args.pid}")
+        pid = int(args.pid)
+        if pid <= 0:
+            # os.kill(0, ...) would signal OUR OWN process group.
+            print("debug kill requires the node's pid", file=sys.stderr)
+            return 1
+        os.kill(pid, 15)
+        print(f"sent SIGTERM to {pid}")
     return 0
 
 

@@ -802,7 +802,11 @@ class ConsensusState:
 
     def _record_commit_metrics(self, block) -> None:
         """consensus/state.go recordMetrics (:1726-1790 subset)."""
+        from cometbft_tpu.consensus.metrics import _Nop
+
         m = self.metrics
+        if isinstance(m.height, _Nop):
+            return  # metrics disabled: skip the block re-encode + DB read
         h = block.header.height
         m.height.set(h)
         m.latest_block_height.set(h)

@@ -97,7 +97,17 @@ def proxy_routes(client, rpc_client, key_path_fn=default_merkle_key_path_fn) -> 
             raise RPCError(-32603, "empty key", None)
         ops_json = (resp.get("proofOps") or {}).get("ops") or []
         if not ops_json:
-            raise RPCError(-32603, "no proof ops", None)
+            # Also the shape of a verified-absence gap: SimpleMap value ops
+            # cannot prove non-membership (the reference's DefaultProofRuntime
+            # has the same limit — absence needs range/IAVL ops), so an
+            # absent key and a proof-stripping node are indistinguishable
+            # here and both must be rejected.
+            raise RPCError(
+                -32603,
+                "no proof ops (value-op apps cannot prove absence; query an "
+                "existing key or use an app with range proofs)",
+                None,
+            )
         resp_height = int(resp.get("height", 0))
         if resp_height <= 0:
             raise RPCError(-32603, "negative or zero height", None)

@@ -434,11 +434,21 @@ class Node:
             self.state_store.bootstrap(state)
             self.block_store.save_seen_commit(state.last_block_height, commit)
             self.blocksync_reactor.switch_to_block_sync(state, self.block_executor)
-        except Exception as e:  # surface, don't kill the process
+        except Exception as e:
+            # Fall back to blocksync-from-genesis rather than leaving a
+            # zombie node (consensus only starts via blocksync's caught-up
+            # hook, and the reactor was built with block_sync=False while
+            # statesync was armed).
             if self.logger:
-                self.logger.error(f"statesync failed: {e}")
+                self.logger.error(
+                    "statesync failed; falling back to blocksync",
+                    module="statesync", err=str(e),
+                )
             else:
-                print(f"statesync failed: {e}")
+                print(f"statesync failed ({e}); falling back to blocksync")
+            self.blocksync_reactor.switch_to_block_sync(
+                self.consensus_state.state, self.block_executor
+            )
 
 
 def _only_validator_is_us(state, priv_validator) -> bool:

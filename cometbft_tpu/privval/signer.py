@@ -143,6 +143,12 @@ class SignerListenerEndpoint:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # Bound reads on the signer connection: request() holds the
+            # endpoint mutex across write+read, and an untimed read on a
+            # half-open connection (peer power loss, partition without RST)
+            # would hold it forever — blocking this accept loop from ever
+            # installing a reconnecting signer.
+            conn.settimeout(10.0)
             with self._mtx:
                 if self._conn is not None:
                     try:

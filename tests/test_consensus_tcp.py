@@ -2,6 +2,7 @@
 MConnections, and the consensus/mempool gossip reactors — no in-memory
 shortcuts. Also exercises late-join catchup gossip."""
 
+import os
 import time
 
 import pytest
@@ -99,7 +100,8 @@ def test_consensus_over_tcp(tcp_net):
             )
         from cometbft_tpu.libs.pprof import thread_stacks
 
-        with open("/root/repo/.stall_dump.txt", "w") as f:
+        dump = os.path.join(os.path.dirname(__file__), "..", ".stall_dump.txt")
+        with open(dump, "w") as f:
             f.write("\n".join(lines) + "\n\n" + thread_stacks())
         raise AssertionError("stuck: " + " | ".join(lines))
     # Tx gossip: submit on node 2; any proposer should include it.
@@ -120,7 +122,8 @@ def test_consensus_over_tcp(tcp_net):
         )
         from cometbft_tpu.libs.pprof import thread_stacks
 
-        with open("/root/repo/.stall_dump.txt", "w") as f:
+        dump = os.path.join(os.path.dirname(__file__), "..", ".stall_dump.txt")
+        with open(dump, "w") as f:
             f.write(diag + "\n\n" + thread_stacks())
         raise AssertionError(f"gossiped tx never committed: {diag}")
     # All nodes agree at height 2.
