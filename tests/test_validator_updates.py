@@ -1,0 +1,96 @@
+"""Live validator-set changes through consensus (reference:
+persistent_kvstore.go validator txs + state/execution.go updateState +
+types/validator_set.go update machinery): a running non-validator node is
+PROMOTED to validator by a "val:pubkeyB64!power" tx, signs blocks, and is
+then demoted back."""
+
+import base64
+import time
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import PersistentKVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+
+CHAIN = "valupd-chain"
+
+
+def test_promote_then_demote_validator():
+    pvs = [MockPV() for _ in range(4)]
+    # Only the first three are genesis validators; node3 runs as a full node.
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs[:3])
+        ],
+    )
+    gen.validate_and_complete()
+
+    def make(pv):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = ""
+        cfg.consensus.timeout_commit = 0.1
+        cfg.consensus.skip_timeout_commit = False
+        return Node(cfg, gen, pv, LocalClientCreator(PersistentKVStoreApplication()))
+
+    nodes = [make(pv) for pv in pvs]
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+
+        def wait_height(target, timeout=60):
+            deadline = time.time() + timeout
+            while time.time() < deadline and cs0.rs.height < target:
+                time.sleep(0.05)
+            assert cs0.rs.height >= target, f"stuck at {cs0.rs.height}"
+
+        wait_height(2)
+        assert cs0.state.validators.size() == 3
+
+        # Promote node3: its pubkey gains power 15.
+        pub3 = pvs[3].get_pub_key()
+        tx = b"val:" + base64.b64encode(pub3.bytes()) + b"!15"
+        nodes[0].mempool.check_tx(tx)
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.state.validators.size() != 4:
+            time.sleep(0.1)
+        assert cs0.state.validators.size() == 4, "validator set never grew"
+        _, val3 = cs0.state.validators.get_by_address(pub3.address())
+        assert val3 is not None and val3.voting_power == 15
+
+        # The chain keeps committing with the new set — total power 45 needs
+        # >30, so the three originals (30) are NOT enough: node3 MUST sign.
+        h_after = cs0.rs.height
+        wait_height(h_after + 4)
+        commit = nodes[0].block_store.load_seen_commit(h_after + 2)
+        signer_addrs = {
+            sig.validator_address
+            for sig in commit.signatures
+            if sig.for_block_flag()
+        }
+        assert pub3.address() in signer_addrs, "promoted validator never signed"
+
+        # Demote node3 back to power 0: set shrinks, chain continues.
+        nodes[1].mempool.check_tx(b"val:" + base64.b64encode(pub3.bytes()) + b"!0")
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.state.validators.size() != 3:
+            time.sleep(0.1)
+        assert cs0.state.validators.size() == 3, "validator set never shrank"
+        h_after = cs0.rs.height
+        wait_height(h_after + 2)
+    finally:
+        for n in nodes:
+            n.stop()
