@@ -185,3 +185,34 @@ def test_kernel_bitmap_matches_pure_on_zip215_edge_vectors():
     assert got[5] is True, "s=0 with identity A satisfies the cofactored eq"
     assert got[9] is True, "noncanonical identity alias must decode (rule 1)"
     assert got[1] is False and got[3] is False
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("CMTPU_SLOW_TESTS"),
+    reason="~2 min XLA:CPU compile; the planar lowering is what the TPU runs "
+    "(set CMTPU_SLOW_TESTS=1)",
+)
+def test_planar_lowering_full_verify_on_cpu():
+    """Force the accelerator (planar) lowering through the whole verify
+    program on XLA:CPU: trace + bitmap must match the compact path."""
+    import importlib
+
+    from cometbft_tpu.ops import field25519 as fe
+
+    prev = fe._PLANAR
+    fe._PLANAR = True
+    try:
+        ek._compiled.cache_clear()
+        pubs, msgs, sigs = [], [], []
+        for i in range(8):
+            priv = ed25519.gen_priv_key_from_secret(b"planar-%d" % i)
+            msg = b"planar-vote-%d" % i
+            pubs.append(priv.pub_key().bytes())
+            msgs.append(msg)
+            sigs.append(priv.sign(msg))
+        sigs[3] = sigs[3][:8] + bytes([sigs[3][8] ^ 1]) + sigs[3][9:]
+        ok, res = ek.batch_verify(pubs, msgs, sigs)
+        assert res == [True, True, True, False, True, True, True, True]
+    finally:
+        fe._PLANAR = prev
+        ek._compiled.cache_clear()
