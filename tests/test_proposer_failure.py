@@ -1,0 +1,96 @@
+"""Liveness past a dead proposer (reference: consensus round progression —
+state.go enterPropose timeout -> prevote nil -> ... -> enterNewRound r+1):
+with one of four validators killed, heights where IT was the proposer must
+advance through round > 0 and commit under a different proposer."""
+
+import time
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.types import cmttime
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+
+CHAIN = "proposer-fail-chain"
+
+
+def test_rounds_advance_past_dead_proposer():
+    pvs = [MockPV() for _ in range(4)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+
+    def make(pv):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = ""
+        cfg.consensus.timeout_commit = 0.1
+        cfg.consensus.skip_timeout_commit = False
+        # Tight but non-degenerate timeouts so a dead-proposer height
+        # resolves in well under a second.
+        cfg.consensus.timeout_propose = 0.3
+        cfg.consensus.timeout_propose_delta = 0.1
+        return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
+
+    nodes = [make(pv) for pv in pvs]
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < 3:
+            time.sleep(0.05)
+        assert cs0.rs.height >= 3
+
+        # Kill validator 3 (its process stays but consensus/gossip stop).
+        victim_addr = pvs[3].address()
+        nodes[3].stop()
+
+        # The remaining 30/40 power is a strict 2/3+ majority: the chain must
+        # keep committing, and heights where the victim is proposer must
+        # resolve at round >= 1.
+        start_h = cs0.rs.height
+        target = start_h + 8
+        deadline = time.time() + 120
+        while time.time() < deadline and cs0.rs.height < target:
+            time.sleep(0.1)
+        assert cs0.rs.height >= target, (
+            f"chain stalled at {cs0.rs.height} after killing a validator"
+        )
+
+        saw_round_progress = False
+        saw_victim_proposer = False
+        for h in range(start_h, cs0.rs.height - 1):
+            commit = nodes[0].block_store.load_seen_commit(h)
+            if commit is None:
+                continue
+            if commit.round >= 1:
+                saw_round_progress = True
+            meta = nodes[0].block_store.load_block_meta(h)
+            if meta is not None and meta.header.proposer_address == victim_addr:
+                saw_victim_proposer = True
+        assert saw_round_progress, (
+            "no committed height needed round >= 1 — dead-proposer heights "
+            "should have forced round progression"
+        )
+        assert not saw_victim_proposer, "dead validator cannot have proposed"
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
