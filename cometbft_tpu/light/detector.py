@@ -181,13 +181,17 @@ def make_attack_evidence(conflicting: LightBlock, common: LightBlock | None):
         for cs in commit.signatures:
             if not cs.for_block_flag():
                 continue
-            val = common.validator_set.get_by_address(cs.validator_address)
+            _, val = common.validator_set.get_by_address(cs.validator_address)
             if val is not None:
                 byzantine.append(val)
+    # Timestamp/total power anchor to the COMMON (trusted) block: the pool's
+    # verifier compares them against ITS chain at evidence.Height() ==
+    # common_height (evidence/verify.go:46), not the attacker's header.
+    anchor = common if common is not None else conflicting
     return LightClientAttackEvidence(
         conflicting_block=conflicting,
-        common_height=common.height if common is not None else conflicting.height,
+        common_height=anchor.height,
         byzantine_validators=byzantine,
         total_voting_power=total_power,
-        timestamp=conflicting.signed_header.header.time,
+        timestamp=anchor.signed_header.header.time,
     )

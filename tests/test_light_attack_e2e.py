@@ -1,0 +1,139 @@
+"""Light-client attack, end to end (reference: light/detector.go ->
+provider report -> rpc broadcast_evidence -> evidence/verify.go
+VerifyLightClientAttack -> committed block): a malicious witness serves a
+forged-but-correctly-signed conflicting header; the detector files
+LightClientAttackEvidence to the REAL chain via RPC and the validators
+commit it."""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.detector import ErrLightClientAttack
+from cometbft_tpu.light.provider import HTTPProvider, MockProvider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.rpc.client import HTTPClient
+from cometbft_tpu.types import BlockID, Commit, LightClientAttackEvidence, Vote, cmttime
+from cometbft_tpu.types.block import PRECOMMIT_TYPE, SignedHeader
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import vote_to_commit_sig
+
+CHAIN = "lattack-chain"
+
+
+class _ForkingWitness(MockProvider):
+    """Serves the real chain EXCEPT at `fork_height`, where it returns a
+    forged header (different app hash) carrying REAL validator signatures —
+    the equivocation a light-client attack consists of."""
+
+    def __init__(self, real: HTTPProvider, pvs, fork_height: int):
+        super().__init__(CHAIN, {})
+        self.real = real
+        self.pvs = {pv.address(): pv for pv in pvs}
+        self.fork_height = fork_height
+        self.forged: LightBlock | None = None
+
+    def light_block(self, height):
+        lb = self.real.light_block(height)
+        if height != self.fork_height:
+            return lb
+        if self.forged is None:
+            header = replace(lb.signed_header.header, app_hash=b"\xee" * 32)
+            bid = BlockID(header.hash(), PartSetHeader(1, b"\x05" * 32))
+            sigs = []
+            for idx, val in enumerate(lb.validator_set.validators):
+                vote = Vote(
+                    type=PRECOMMIT_TYPE, height=height, round=0, block_id=bid,
+                    timestamp=header.time.add_nanos(10**9),
+                    validator_address=val.address, validator_index=idx,
+                )
+                signed = self.pvs[val.address].sign_vote(CHAIN, vote)
+                sigs.append(vote_to_commit_sig(signed))
+            commit = Commit(height=height, round=0, block_id=bid, signatures=sigs)
+            self.forged = LightBlock(
+                signed_header=SignedHeader(header, commit),
+                validator_set=lb.validator_set,
+            )
+        return self.forged
+
+
+def test_detector_evidence_reaches_committed_block():
+    pvs = [MockPV() for _ in range(3)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+
+    def make(pv, i):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = "tcp://127.0.0.1:0" if i == 0 else ""
+        cfg.consensus.timeout_commit = 0.2
+        cfg.consensus.skip_timeout_commit = False
+        return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
+
+    nodes = [make(pv, i) for i, pv in enumerate(pvs)]
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < 5:
+            time.sleep(0.05)
+        assert cs0.rs.height >= 5
+
+        url = f"http://127.0.0.1:{nodes[0].rpc_port}"
+        primary = HTTPProvider(CHAIN, HTTPClient(url))
+        fork_h = 3
+        witness = _ForkingWitness(HTTPProvider(CHAIN, HTTPClient(url)), pvs, fork_h)
+        lb1 = primary.light_block(1)
+        client = Client(
+            CHAIN,
+            TrustOptions(period_ns=3600 * 10**9, height=1, hash=lb1.hash()),
+            primary,
+            [witness],
+            LightStore(MemDB()),
+        )
+        with pytest.raises(ErrLightClientAttack):
+            client.verify_light_block_at_height(fork_h)
+
+        # The detector must have reported the attack to the primary's RPC:
+        # LightClientAttackEvidence flows through the pool into a block.
+        deadline = time.time() + 60
+        found = None
+        while time.time() < deadline and found is None:
+            for h in range(1, cs0.rs.height):
+                blk = nodes[0].block_store.load_block(h)
+                for ev in (blk.evidence if blk else []):
+                    if isinstance(ev, LightClientAttackEvidence):
+                        found = (h, ev)
+            time.sleep(0.3)
+        assert found is not None, "light-attack evidence never committed"
+        _, ev = found
+        assert ev.conflicting_block.signed_header.header.height == fork_h
+        assert ev.total_voting_power == 30
+        assert len(ev.byzantine_validators) == 3, "all signers were byzantine"
+    finally:
+        for n in nodes:
+            n.stop()
