@@ -69,7 +69,10 @@ class Node:
         # Storage (node/node.go:147 initDBs).
         db_dir = config.base.db_path()
         self.block_store = BlockStore(new_db("blockstore", config.base.db_backend, db_dir))
-        self.state_store = StateStore(new_db("state", config.base.db_backend, db_dir))
+        self.state_store = StateStore(
+            new_db("state", config.base.db_backend, db_dir),
+            discard_abci_responses=config.storage.discard_abci_responses,
+        )
 
         # State from DB or genesis (node/node.go:156).
         state = self.state_store.load()

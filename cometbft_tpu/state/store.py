@@ -37,8 +37,13 @@ def _abci_responses_key(height: int) -> bytes:
 class StateStore:
     """state/store.go dbStore."""
 
-    def __init__(self, db: DB):
+    def __init__(self, db: DB, discard_abci_responses: bool = False):
         self._db = db
+        # storage.discard_abci_responses: keep ONLY the latest height's
+        # responses (still needed by the handshake's ran-Commit-but-didn't-
+        # save-state replay) — /block_results for older heights is gone
+        # (state/store.go Options.DiscardABCIResponses).
+        self.discard_abci_responses = discard_abci_responses
 
     # -- state ---------------------------------------------------------------
 
@@ -139,7 +144,10 @@ class StateStore:
 
     def save_abci_responses(self, height: int, responses: dict) -> None:
         """state/store.go SaveABCIResponses: {deliver_txs, end_block, begin_block}
-        stored for reindexing and /block_results."""
+        stored for reindexing and /block_results; under discard mode only the
+        latest height survives (store.go:344)."""
+        if self.discard_abci_responses:
+            self._db.delete(_abci_responses_key(height - 1))
         self._db.set(_abci_responses_key(height), json.dumps(responses).encode())
 
     def load_abci_responses(self, height: int) -> dict | None:

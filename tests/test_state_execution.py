@@ -144,3 +144,22 @@ def test_median_time_weighting(rig):
     # t2 reached when remaining median (10) <= weight (10).
     mt = median_time(commit, state.validators)
     assert mt == Time(1700000020, 0)
+
+
+def test_discard_abci_responses_keeps_only_latest():
+    """storage.discard_abci_responses (state/store.go Options): older
+    heights' responses are dropped, the latest survives for replay."""
+    from cometbft_tpu.libs.db import MemDB
+    from cometbft_tpu.state.store import StateStore
+
+    ss = StateStore(MemDB(), discard_abci_responses=True)
+    for h in range(1, 6):
+        ss.save_abci_responses(h, {"deliver_txs": [], "h": h})
+    assert ss.load_abci_responses(5) == {"deliver_txs": [], "h": 5}
+    for h in range(1, 5):
+        assert ss.load_abci_responses(h) is None, f"height {h} not discarded"
+
+    keep = StateStore(MemDB())
+    for h in range(1, 4):
+        keep.save_abci_responses(h, {"h": h})
+    assert all(keep.load_abci_responses(h) is not None for h in range(1, 4))
