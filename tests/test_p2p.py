@@ -165,3 +165,69 @@ def test_fuzzed_delay_connection_still_delivers():
     finally:
         for sw, _ in sws:
             sw.stop()
+
+
+def test_fuzzed_drop_connection_reconnects():
+    """p2p/fuzz.go drop mode: swallowed writes corrupt the framed stream,
+    peers disconnect, and the persistent-peer redial machinery restores the
+    connection — the churn loop the fuzzer exists to exercise."""
+    import time as _time
+
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+    from cometbft_tpu.p2p.fuzz import FuzzConnConfig
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.node_info import NodeInfo
+    from cometbft_tpu.p2p.reactor import Reactor
+    from cometbft_tpu.p2p.switch import Switch
+    from cometbft_tpu.p2p.transport import MultiplexTransport
+
+    class Chat(Reactor):
+        def __init__(self):
+            super().__init__("CHAT")
+            self.got = 0
+
+        def get_channels(self):
+            return [ChannelDescriptor(0x78, priority=1, send_queue_capacity=10)]
+
+        def receive(self, chan_id, peer, msg_bytes):
+            self.got += 1
+
+    # Only node A fuzzes; dropped WRITES are clean message drops in this
+    # layering (whole sealed frames vanish pre-nonce), so connection churn
+    # comes from prob_drop_conn, which hard-closes the socket.
+    fuzz = FuzzConnConfig(mode="drop", prob_drop_rw=0.1, prob_drop_conn=0.1, seed=3)
+    nk_a, nk_b = NodeKey(), NodeKey()
+    ni_a = NodeInfo(node_id=nk_a.id, network="fuzz2", moniker="a")
+    ni_b = NodeInfo(node_id=nk_b.id, network="fuzz2", moniker="b")
+    sw_a = Switch(ni_a, MultiplexTransport(ni_a, nk_a, fuzz))
+    sw_b = Switch(ni_b, MultiplexTransport(ni_b, nk_b))
+    chat_a, chat_b = Chat(), Chat()
+    sw_a.add_reactor("CHAT", chat_a)
+    sw_b.add_reactor("CHAT", chat_b)
+    try:
+        addr_b = sw_b.start("127.0.0.1:0")
+        sw_a.start("127.0.0.1:0")
+        sw_a.add_persistent_peers([f"{nk_b.id}@{addr_b}"])
+        sw_a.dial_persistent_peers()
+        drops = reconnects = 0
+        connected_before = False
+        deadline = _time.time() + 30
+        while _time.time() < deadline and reconnects < 2:
+            connected = sw_a.get_peer(nk_b.id) is not None
+            if connected:
+                p = sw_a.get_peer(nk_b.id)
+                if p:
+                    p.try_send(0x78, b"chatter")
+                if not connected_before:
+                    if drops > 0:
+                        reconnects += 1
+                    connected_before = True
+            elif connected_before:
+                drops += 1
+                connected_before = False
+            _time.sleep(0.02)
+        assert drops >= 1, "drop-mode fuzzing never broke the connection"
+        assert reconnects >= 1, "persistent redial never restored the peer"
+    finally:
+        sw_a.stop()
+        sw_b.stop()
