@@ -61,12 +61,18 @@ class Group:
 
     def write(self, data: bytes) -> None:
         with self._mtx:
-            self._head.write(data)
+            try:
+                self._head.write(data)
+            except ValueError:
+                pass  # closed during shutdown: late writers are no-ops
 
     def flush_and_sync(self) -> None:
         with self._mtx:
-            self._head.flush()
-            os.fsync(self._head.fileno())
+            try:
+                self._head.flush()
+                os.fsync(self._head.fileno())
+            except ValueError:
+                pass  # closed during shutdown
 
     def maybe_rotate(self) -> bool:
         """group.go checkHeadSizeLimit: rotate when the head is over limit.
@@ -74,8 +80,11 @@ class Group:
         with self._mtx:
             if self.head_size_limit <= 0:
                 return False
-            if self._head.tell() < self.head_size_limit:
-                return False
+            try:
+                if self._head.tell() < self.head_size_limit:
+                    return False
+            except ValueError:
+                return False  # closed during shutdown
             self._head.flush()
             os.fsync(self._head.fileno())
             self._head.close()

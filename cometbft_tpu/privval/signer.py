@@ -100,6 +100,17 @@ def _dec_pub_key(data: bytes):
     return dec(data)
 
 
+def _shutdown_close(conn: socket.socket) -> None:
+    try:
+        conn.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
 # -- node side ----------------------------------------------------------------
 
 
@@ -150,11 +161,14 @@ class SignerListenerEndpoint:
             # installing a reconnecting signer.
             conn.settimeout(10.0)
             with self._mtx:
-                if self._conn is not None:
-                    try:
-                        self._conn.close()
-                    except OSError:
-                        pass
+                if not self._running:
+                    # A thread parked in accept() keeps the kernel listener
+                    # alive past listener.close(), so a redialing signer can
+                    # still connect and land HERE after close() — installing
+                    # it would strand the signer on a dead endpoint.
+                    _shutdown_close(conn)
+                    return
+                self._drop_conn_locked()
                 self._conn = conn
                 self._rf = conn.makefile("rb")
                 self._wf = conn.makefile("wb")
@@ -167,19 +181,15 @@ class SignerListenerEndpoint:
         except OSError:
             pass
         with self._mtx:
-            if self._conn is not None:
-                try:
-                    self._conn.close()
-                except OSError:
-                    pass
-                self._conn = None
+            self._drop_conn_locked()
+            self._have_conn.notify_all()  # wake request() waiters to fail fast
 
     def _drop_conn_locked(self) -> None:
         if self._conn is not None:
-            try:
-                self._conn.close()
-            except OSError:
-                pass
+            # shutdown() before close(): the makefile() reader/writer keep
+            # the fd alive past close(), so no FIN would reach the signer
+            # and it would never notice the endpoint is gone.
+            _shutdown_close(self._conn)
         self._conn = None
         self._rf = self._wf = None
 
@@ -188,6 +198,8 @@ class SignerListenerEndpoint:
         with self._mtx:
             deadline = time.monotonic() + self.accept_timeout
             while self._conn is None:
+                if not self._running:
+                    raise ConnectionError("signer endpoint closed")
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError("no signer connected")
