@@ -30,6 +30,11 @@ class Group:
         self.total_size_limit = total_size_limit
         self._mtx = threading.Lock()
         self._head = open(head_path, "ab")
+        # Orderly-shutdown intent. Late writers racing close() are benign
+        # no-ops ONLY when close() was actually called; any other closed-file
+        # state (teardown-order bug, double stop) must keep crashing loudly
+        # instead of silently dropping WAL frames or faking durability.
+        self._closed = False
 
     # -- index bookkeeping -----------------------------------------------------
 
@@ -61,30 +66,25 @@ class Group:
 
     def write(self, data: bytes) -> None:
         with self._mtx:
-            try:
-                self._head.write(data)
-            except ValueError:
-                pass  # closed during shutdown: late writers are no-ops
+            if self._closed:
+                return  # orderly shutdown: late writers are no-ops
+            self._head.write(data)
 
     def flush_and_sync(self) -> None:
         with self._mtx:
-            try:
-                self._head.flush()
-                os.fsync(self._head.fileno())
-            except ValueError:
-                pass  # closed during shutdown
+            if self._closed:
+                return  # orderly shutdown
+            self._head.flush()
+            os.fsync(self._head.fileno())
 
     def maybe_rotate(self) -> bool:
         """group.go checkHeadSizeLimit: rotate when the head is over limit.
         Called between frames so rotation never splits a record."""
         with self._mtx:
-            if self.head_size_limit <= 0:
+            if self.head_size_limit <= 0 or self._closed:
                 return False
-            try:
-                if self._head.tell() < self.head_size_limit:
-                    return False
-            except ValueError:
-                return False  # closed during shutdown
+            if self._head.tell() < self.head_size_limit:
+                return False
             self._head.flush()
             os.fsync(self._head.fileno())
             self._head.close()
@@ -121,6 +121,7 @@ class Group:
 
     def close(self) -> None:
         with self._mtx:
+            self._closed = True
             try:
                 self._head.flush()
                 os.fsync(self._head.fileno())
@@ -135,9 +136,12 @@ class Group:
             except OSError:
                 pass
             self._head = open(self.head_path, "ab")
+            self._closed = False
 
     def head_size(self) -> int:
         with self._mtx:
+            if self._closed:
+                return 0
             return self._head.tell()
 
     # -- reading ---------------------------------------------------------------

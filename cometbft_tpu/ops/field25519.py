@@ -11,27 +11,36 @@ constants. Limbs carry a LOOSE invariant: every public op returns limbs in
   - column sums:      <= 17 * 2^15.2       < 2^19.3  (int32)
   - 19-fold:          < 2^23.7             (int32)
 
-The multiply has TWO lowerings, chosen per backend at trace time:
+The multiply has THREE lowerings, chosen per backend at trace time:
 
-  - PLANAR (TPU): all 289 limb products and their column sums are emitted as
-    individual [N]-wide VPU ops (one big XLA fusion), not as a [17,17,N]
-    tensor + accumulation matmul. On TPU v5e the planar form measured ~2.5x
-    faster than the matmul form (the f32 HIGHEST accumulation matmul runs as
-    a 6-pass bf16 emulation, and the [17,17,N] intermediates cost HBM
-    round-trips), and TPU compile time scales linearly with chain length.
+  - STACKED (TPU default): the schoolbook convolution as ~35 chunky HLO ops
+    — pad x to 33 limbs, stack 17 rolls into a Toeplitz band [17, 33, N],
+    broadcast-multiply by y, 15-bit-split, reduce over the j axis, 19-fold,
+    stacked carries. Same 289 limb products as the planar form but the
+    graph is ~45x smaller: the planar program for the full verify ladder
+    took XLA:TPU >8 MINUTES to compile (pass time superlinear in the
+    ~75k-op loop body), which timed out the round-3 bench driver; the
+    stacked program compiles in seconds and runs on the same VPU path.
+  - PLANAR (opt-in via CMTPU_FE_MODE=planar): all 289 limb products and
+    their column sums as individual [N]-wide VPU ops (one big XLA fusion).
+    Minimal arithmetic (no padded zeros, squaring symmetry) but compile
+    time makes it unshippable for the ladder; kept for A/B probes.
   - COMPACT (CPU): the [17,17,N] product tensor + one-hot f32 accumulation
     matmul (~15 HLO ops per multiply). XLA:CPU's compile time is quadratic
     in elementwise-fusion size — a straight-line chain of 8 planar muls
     takes minutes to compile on CPU — so the CPU backend (tests, the
     8-virtual-device dryrun, the host fallback) gets the small-graph form.
 
-Carries are planar shift-mask chains in both forms. This is the TPU-native
-replacement for curve25519-voi's assembly field element (reference backend
-of crypto/ed25519/ed25519.go:27-29).
+Carries are one shift-mask pass per call: ~4 array ops on the stacked form
+(_carry_arr, used by the stacked and compact lowerings) or 17 planar
+shift-mask chains under CMTPU_FE_MODE=planar (_carry_rows). This is the
+TPU-native replacement for curve25519-voi's assembly field element
+(reference backend of crypto/ed25519/ed25519.go:27-29).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from contextlib import contextmanager
 
@@ -116,7 +125,9 @@ def _carry_rows(c: list) -> list:
 
 
 def _carry(x: jnp.ndarray) -> jnp.ndarray:
-    return jnp.stack(_carry_rows(_rows(x)))
+    if _mode() == "planar":
+        return jnp.stack(_carry_rows(_rows(x)))
+    return _carry_arr(x)
 
 
 def _mul_rows(xs: list, ys: list) -> list:
@@ -156,6 +167,39 @@ def _sq_rows(xs: list) -> list:
     return _carry_rows(_carry_rows(folded))
 
 
+# -- stacked (Toeplitz-band) multiply: the TPU-default lowering --------------
+
+
+def _carry_arr(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass as ~4 array ops on the stacked [17, N] form
+    (same math as _carry_rows: split at 15 bits, carry up one limb, top
+    carry wraps to limb 0 with factor 19)."""
+    hi = x >> LIMB_BITS
+    lo = x & MASK
+    wrap = jnp.concatenate([19 * hi[LIMBS - 1 :], hi[: LIMBS - 1]], axis=0)
+    return lo + wrap
+
+
+def _mul_stacked(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook multiply as ~35 chunky HLO ops: z_col[c] = sum_j
+    x[c-j] * y[j] via a rolled Toeplitz band. Products are split at 15 bits
+    BEFORE the j-reduction (raw column sums of 2^30.2 products would
+    overflow int32), the high halves land one column up, and columns 17..33
+    fold back with factor 19 (2^255 = 19 mod p). All bounds as the planar
+    form: split sums < 2^19.3, folded columns < 2^24.5, two carry passes
+    restore the loose invariant."""
+    n = x.shape[1]
+    xp = jnp.concatenate([x, jnp.zeros((LIMBS - 1, n), jnp.int32)], axis=0)
+    band = jnp.stack([jnp.roll(xp, j, axis=0) for j in range(LIMBS)])
+    p = band * y[:, None, :]  # [17 (j), 33 (col), N], each < 2^30.2
+    lo = (p & MASK).sum(axis=0)  # [33, N], < 17 * 2^15
+    hi = (p >> LIMB_BITS).sum(axis=0)  # [33, N], < 17 * 2^15.2
+    zrow = jnp.zeros((1, n), jnp.int32)
+    cols = jnp.concatenate([lo, zrow], axis=0) + jnp.concatenate([zrow, hi], axis=0)
+    folded = cols[:LIMBS] + 19 * cols[LIMBS:]
+    return _carry_arr(_carry_arr(folded))
+
+
 # -- compact (matmul-accumulation) multiply for the CPU backend --------------
 
 # One-hot accumulation matrix: entry [k, j*17+i] = 1 where the low half of
@@ -187,31 +231,48 @@ def _mul_compact(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return _carry(_carry(folded))
 
 
-_PLANAR: bool | None = None
+_ACCEL: bool | None = None
 _SCOPE = threading.local()
+# CMTPU_FE_MODE: auto (default; stacked on accelerators, compact on CPU),
+# or an explicit stacked / planar / compact override for A/B probes. A typo
+# must fail loudly, not silently measure the default lowering.
+_MODE_ENV = os.environ.get("CMTPU_FE_MODE", "auto")
+if _MODE_ENV not in ("auto", "stacked", "planar", "compact"):
+    raise ValueError(
+        f"CMTPU_FE_MODE={_MODE_ENV!r}: expected auto|stacked|planar|compact"
+    )
 
 
-def _use_planar() -> bool:
-    """Planar lowering on accelerators, compact on CPU (see module
-    docstring). Matched by exclusion: the TPU tunnel on this deployment
-    registers its PJRT platform as "axon", not "tpu". The backend is sampled
-    once per process — mixed-backend processes would need per-trace plumbing
-    this framework doesn't require."""
-    global _PLANAR
-    if getattr(_SCOPE, "compact", False):
-        return False
-    if _PLANAR is None:
-        _PLANAR = jax.default_backend() != "cpu"
-    return _PLANAR
+def _is_accel() -> bool:
+    """True on non-CPU backends. Matched by exclusion: the TPU tunnel on
+    this deployment registers its PJRT platform as "axon", not "tpu". The
+    backend is sampled once per process — mixed-backend processes would need
+    per-trace plumbing this framework doesn't require."""
+    global _ACCEL
+    if _ACCEL is None:
+        _ACCEL = jax.default_backend() != "cpu"
+    return _ACCEL
+
+
+def _mode() -> str:
+    """Lowering for the current trace (see module docstring)."""
+    if _MODE_ENV in ("stacked", "compact"):
+        return _MODE_ENV
+    if _MODE_ENV == "planar":
+        # Historical behavior for A/B probes: planar ladder, compact scopes.
+        if getattr(_SCOPE, "compact", False) or not _is_accel():
+            return "compact"
+        return "planar"
+    return "stacked" if _is_accel() else "compact"
 
 
 @contextmanager
 def compact_scope():
-    """Force the compact lowering inside this trace region. Planar multiplies
-    cost ~1.5k HLO ops each; STRAIGHT-LINE sections (decompression's
-    inversion chain, final adds) would dominate compile time for a marginal
-    runtime share, so the verify kernel scopes planar to its loop-rolled
-    ladder and compiles everything else compact."""
+    """Mark a STRAIGHT-LINE trace region (decompression's inversion chain,
+    final adds). Only meaningful under CMTPU_FE_MODE=planar, where such
+    sections would dominate compile time for a marginal runtime share and
+    are forced compact; the default stacked lowering is small-graph
+    everywhere, so the scope is a no-op there."""
     prev = getattr(_SCOPE, "compact", False)
     _SCOPE.compact = True
     try:
@@ -222,13 +283,19 @@ def compact_scope():
 
 def fe_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """z = x*y mod p under the loose invariant."""
-    if _use_planar():
+    m = _mode()
+    if m == "stacked":
+        return _mul_stacked(x, y)
+    if m == "planar":
         return jnp.stack(_mul_rows(_rows(x), _rows(y)))
     return _mul_compact(x, y)
 
 
 def fe_sq(x: jnp.ndarray) -> jnp.ndarray:
-    if _use_planar():
+    m = _mode()
+    if m == "stacked":
+        return _mul_stacked(x, x)
+    if m == "planar":
         return jnp.stack(_sq_rows(_rows(x)))
     return _mul_compact(x, x)
 

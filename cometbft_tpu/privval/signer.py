@@ -153,7 +153,14 @@ class SignerListenerEndpoint:
             try:
                 conn, _ = self._listener.accept()
             except OSError:
-                return
+                if not self._running:
+                    return
+                # Transient accept failure (ECONNABORTED from a signer that
+                # hung up while queued, EMFILE pressure): the endpoint must
+                # keep accepting or the validator misses votes until a
+                # process restart.
+                time.sleep(0.05)
+                continue
             # Bound reads on the signer connection: request() holds the
             # endpoint mutex across write+read, and an untimed read on a
             # half-open connection (peer power loss, partition without RST)
@@ -176,10 +183,12 @@ class SignerListenerEndpoint:
 
     def close(self) -> None:
         self._running = False
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        # shutdown() (inside the helper) wakes a thread parked in accept()
+        # (close() alone does not on Linux), so the accept loop exits and
+        # the kernel listener actually dies — otherwise a tcp:// endpoint
+        # would keep its port bound forever and a same-port re-create would
+        # fail with EADDRINUSE.
+        _shutdown_close(self._listener)
         with self._mtx:
             self._drop_conn_locked()
             self._have_conn.notify_all()  # wake request() waiters to fail fast

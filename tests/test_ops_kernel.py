@@ -187,26 +187,19 @@ def test_kernel_bitmap_matches_pure_on_zip215_edge_vectors():
     assert got[1] is False and got[3] is False
 
 
-@pytest.mark.skipif(
-    not __import__("os").environ.get("CMTPU_SLOW_TESTS"),
-    reason="~2 min XLA:CPU compile; the planar lowering is what the TPU runs "
-    "(set CMTPU_SLOW_TESTS=1)",
-)
-def test_planar_lowering_full_verify_on_cpu():
-    """Force the accelerator (planar) lowering through the whole verify
-    program on XLA:CPU: trace + bitmap must match the compact path."""
-    import importlib
-
+def _force_mode_verify(mode: str, accel: bool):
+    """Run the full verify program on XLA:CPU under a forced fe lowering
+    mode; the bitmap must match the default (compact) path."""
     from cometbft_tpu.ops import field25519 as fe
 
-    prev = fe._PLANAR
-    fe._PLANAR = True
+    prev_mode, prev_accel = fe._MODE_ENV, fe._ACCEL
+    fe._MODE_ENV, fe._ACCEL = mode, accel
     try:
         ek._compiled.cache_clear()
         pubs, msgs, sigs = [], [], []
         for i in range(8):
-            priv = ed25519.gen_priv_key_from_secret(b"planar-%d" % i)
-            msg = b"planar-vote-%d" % i
+            priv = ed25519.gen_priv_key_from_secret(b"%s-%d" % (mode.encode(), i))
+            msg = b"%s-vote-%d" % (mode.encode(), i)
             pubs.append(priv.pub_key().bytes())
             msgs.append(msg)
             sigs.append(priv.sign(msg))
@@ -214,5 +207,20 @@ def test_planar_lowering_full_verify_on_cpu():
         ok, res = ek.batch_verify(pubs, msgs, sigs)
         assert res == [True, True, True, False, True, True, True, True]
     finally:
-        fe._PLANAR = prev
+        fe._MODE_ENV, fe._ACCEL = prev_mode, prev_accel
         ek._compiled.cache_clear()
+
+
+def test_stacked_lowering_full_verify_on_cpu():
+    """The TPU-default (stacked) lowering through the whole verify program,
+    forced on XLA:CPU — small graphs, so this runs in the normal suite."""
+    _force_mode_verify("stacked", accel=True)
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("CMTPU_SLOW_TESTS"),
+    reason="~2 min XLA:CPU compile; planar is the opt-in A/B lowering "
+    "(set CMTPU_SLOW_TESTS=1)",
+)
+def test_planar_lowering_full_verify_on_cpu():
+    _force_mode_verify("planar", accel=True)

@@ -1,34 +1,66 @@
 #!/bin/bash
-# Poll the axon relay port; the moment it accepts, run ONE phased TPU warmup
-# (bench worker) to compile+cache the kernels and capture a real device
-# number. Logs to tpu_watch.log. Exits after the first successful warmup or
-# after ~11h.
+# Long-lived watcher for the axon TPU relay (127.0.0.1:8082).
+#
+# The tunnel flaps: it wedges under concurrent jax clients (every python
+# start dials it via sitecustomize's register() while PALLAS_AXON_POOL_IPS
+# is set) and can stay down for hours. This loop polls the port and, each
+# time it comes up, runs bench.py to (a) warm the remote-compile cache and
+# (b) capture a real device number for the round. Re-runs every ~30 min
+# while the tunnel stays up so the newest kernel code gets measured.
+#
+# State files (repo root):
+#   .tpu_status    one line: POLLING | RUNNING | OK <unix-ts>
+#   tpu_watch.log  append-only history
+#   tpu_bench_latest.json  last JSON line bench.py printed on a real device
 cd /root/repo
-log() { echo "[watch $(date +%H:%M:%S)] $*" >> tpu_watch.log; }
-log "watcher started"
-for i in $(seq 1 660); do
-  if python - <<'EOF'
-import socket, sys
-s = socket.socket(); s.settimeout(3)
-try:
-    s.connect(("127.0.0.1", 8082)); sys.exit(0)
-except OSError:
-    sys.exit(1)
-EOF
-  then
-    log "relay port OPEN (iteration $i); running warmup"
-    timeout 900 python -u bench.py --worker > tpu_warm.out 2> tpu_warm.err
-    rc=$?
-    log "warmup rc=$rc"
-    tail -20 tpu_warm.err >> tpu_watch.log
-    cat tpu_warm.out >> tpu_watch.log
-    if [ "$rc" = "0" ]; then
-      log "TPU warmup SUCCEEDED — compile cache warm"
-      exit 0
-    fi
-    log "warmup failed; continuing to poll"
-    sleep 300
+PIDFILE=.tpu_watch.pid
+# Stale-pidfile guard: the PID must both be alive AND still be this script
+# (a SIGKILLed watcher leaves the file behind; a recycled PID would
+# otherwise make every later launch exit "already running" with rc 0).
+if [ -f "$PIDFILE" ]; then
+  oldpid=$(cat "$PIDFILE")
+  if kill -0 "$oldpid" 2>/dev/null && \
+     grep -qa tpu_watch /proc/"$oldpid"/cmdline 2>/dev/null; then
+    echo "watcher already running (pid $oldpid)"; exit 0
   fi
-  sleep 60
+fi
+echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
+log() { echo "[watch $(date +%H:%M:%S)] $*" >> tpu_watch.log; }
+log "watcher started (pid $$)"
+echo POLLING > .tpu_status
+
+port_open() {
+  timeout 5 bash -c 'echo > /dev/tcp/127.0.0.1/8082' 2>/dev/null
+}
+
+for i in $(seq 1 1400); do
+  if port_open; then
+    log "relay OPEN (iter $i); running bench"
+    echo RUNNING > .tpu_status
+    timeout 1500 python -u bench.py > tpu_bench.out 2> tpu_bench.err
+    rc=$?
+    log "bench rc=$rc"
+    tail -25 tpu_bench.err >> tpu_watch.log
+    cat tpu_bench.out >> tpu_watch.log
+    # Device success = a JSON line whose platform is NOT the cpu fallback
+    # (the PJRT platform may register as "tpu" or "axon" depending on the
+    # tunnel deployment).
+    if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
+       ! grep -q '"platform": "cpu' tpu_bench.out; then
+      grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
+      log "device bench OK -> tpu_bench_latest.json"
+      echo "OK $(date +%s)" > .tpu_status
+      sleep 1800
+    else
+      echo POLLING > .tpu_status
+      log "no device number; back to polling"
+      sleep 180
+    fi
+  else
+    echo POLLING > .tpu_status
+    sleep 30
+  fi
 done
-log "watcher expired without a successful warmup"
+log "watcher expired"
+rm -f "$PIDFILE"
