@@ -87,9 +87,23 @@ def run_command(client: SocketClient, parts: list[str]) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="abci-cli")
     p.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    p.add_argument(
+        "--transport",
+        choices=["socket", "grpc"],
+        default=None,
+        help="defaults to socket, or grpc when --addr is grpc://",
+    )
     p.add_argument("command", nargs="*", help="command, or 'console'")
     args = p.parse_args(argv)
-    client = SocketClient(args.addr, connect_timeout=5.0)
+    transport = args.transport or (
+        "grpc" if args.addr.startswith("grpc://") else "socket"
+    )
+    if transport == "grpc":
+        from cometbft_tpu.abci.grpc import GrpcClient
+
+        client = GrpcClient(args.addr, connect_timeout=5.0)
+    else:
+        client = SocketClient(args.addr, connect_timeout=5.0)
     try:
         if not args.command or args.command[0] == "console":
             print(f"connected to {args.addr}; 'help' for commands, ctrl-d to exit")

@@ -12,6 +12,54 @@ import threading
 from cometbft_tpu.abci import types as abci
 
 
+class AsyncCheckTxMixin:
+    """Single-dispatch-thread CheckTxAsync, shared by the remote transports
+    (socket, grpc): preserves the mempool's pipelined ordering, and a failed
+    CheckTx must NOT kill the dispatch thread — the mempool would silently
+    stop admitting txs forever. Transports implement _do_check_tx(req) and
+    call _start_async()/_stop_async() around their connection lifetime."""
+
+    def _start_async(self, name: str) -> None:
+        self._async_queue: list = []
+        self._async_cv = threading.Condition()
+        self._async_running = True
+        threading.Thread(target=self._async_loop, daemon=True, name=name).start()
+
+    def _stop_async(self) -> None:
+        self._async_running = False
+        with self._async_cv:
+            self._async_cv.notify_all()
+
+    def _do_check_tx(self, req) -> "abci.ResponseCheckTx":
+        raise NotImplementedError
+
+    def _async_error_response(self, e: Exception) -> "abci.ResponseCheckTx":
+        return abci.ResponseCheckTx(code=1, log=f"abci transport error: {e}")
+
+    def check_tx_async(self, req, callback=None):
+        with self._async_cv:
+            self._async_queue.append((req, callback))
+            self._async_cv.notify()
+
+    def _async_loop(self) -> None:
+        while self._async_running:
+            with self._async_cv:
+                while self._async_running and not self._async_queue:
+                    self._async_cv.wait()
+                if not self._async_running:
+                    return
+                req, callback = self._async_queue.pop(0)
+            try:
+                res = self._do_check_tx(req)
+            except Exception as e:
+                res = self._async_error_response(e)
+            if callback is not None:
+                try:
+                    callback(res)
+                except Exception:
+                    pass
+
+
 class Client:
     """Sync client surface used by proxy.AppConns."""
 
@@ -147,7 +195,7 @@ class LocalClient(Client):
             return self._app.apply_snapshot_chunk(req)
 
 
-class SocketClient(Client):
+class SocketClient(AsyncCheckTxMixin, Client):
     """abci/client/socket_client.go over the gogoproto-framed stream, in
     synchronous form: the node's four proxy connections each own one
     SocketClient, every call writes Request+Flush and reads Response+Flush
@@ -184,18 +232,10 @@ class SocketClient(Client):
         self._rf = s.makefile("rb")
         self._wf = s.makefile("wb")
         self._mtx = threading.Lock()
-        self._async_queue: list = []
-        self._async_cv = threading.Condition()
-        self._async_thread = threading.Thread(
-            target=self._async_loop, daemon=True, name="abci-socket-async"
-        )
-        self._async_running = True
-        self._async_thread.start()
+        self._start_async("abci-socket-async")
 
     def close(self) -> None:
-        self._async_running = False
-        with self._async_cv:
-            self._async_cv.notify_all()
+        self._stop_async()
         try:
             self._sock.close()
         except OSError:
@@ -221,27 +261,8 @@ class SocketClient(Client):
             raise RuntimeError(f"ABCI app exception: {resp.error}")
         return resp
 
-    def _async_loop(self) -> None:
-        while self._async_running:
-            with self._async_cv:
-                while self._async_running and not self._async_queue:
-                    self._async_cv.wait()
-                if not self._async_running:
-                    return
-                req, callback = self._async_queue.pop(0)
-            try:
-                res = self._call(req)
-            except Exception as e:
-                # One failed CheckTx (app exception / socket flap) must not
-                # kill the dispatch thread — the mempool would silently stop
-                # admitting txs forever. Deliver an error response and keep
-                # draining.
-                res = abci.ResponseCheckTx(code=1, log=f"abci socket error: {e}")
-            if callback is not None:
-                try:
-                    callback(res)
-                except Exception:
-                    pass
+    def _do_check_tx(self, req):
+        return self._call(req)
 
     def echo(self, msg: str):
         return self._call(abci.RequestEcho(message=msg))
@@ -260,11 +281,6 @@ class SocketClient(Client):
 
     def check_tx(self, req):
         return self._call(req)
-
-    def check_tx_async(self, req, callback=None):
-        with self._async_cv:
-            self._async_queue.append((req, callback))
-            self._async_cv.notify()
 
     def begin_block(self, req):
         return self._call(req)

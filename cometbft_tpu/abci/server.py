@@ -109,42 +109,49 @@ class ABCIServer:
                 pass
 
     def _dispatch(self, req):
-        a = self.app
         with self._mtx:
-            t = type(req).__name__
-            if t == "RequestEcho":
-                return abci.ResponseEcho(message=req.message)
-            if t == "RequestFlush":
-                return abci.ResponseFlush()
-            if t == "RequestInfo":
-                return a.info(req)
-            if t == "RequestInitChain":
-                return a.init_chain(req)
-            if t == "RequestQuery":
-                return a.query(req)
-            if t == "RequestCheckTx":
-                return a.check_tx(req)
-            if t == "RequestBeginBlock":
-                return a.begin_block(req)
-            if t == "RequestDeliverTx":
-                return a.deliver_tx(req)
-            if t == "RequestEndBlock":
-                return a.end_block(req)
-            if t == "RequestCommit":
-                return a.commit()
-            if t == "RequestPrepareProposal":
-                return a.prepare_proposal(req)
-            if t == "RequestProcessProposal":
-                return a.process_proposal(req)
-            if t == "RequestListSnapshots":
-                return a.list_snapshots(req)
-            if t == "RequestOfferSnapshot":
-                return a.offer_snapshot(req)
-            if t == "RequestLoadSnapshotChunk":
-                return a.load_snapshot_chunk(req)
-            if t == "RequestApplySnapshotChunk":
-                return a.apply_snapshot_chunk(req)
-            raise ValueError(f"unknown request {t}")
+            return dispatch_request(self.app, req)
+
+
+def dispatch_request(a: abci.Application, req):
+    """Route one decoded ABCI request to the Application — shared by the
+    socket and gRPC servers (the reference duplicates this shape in
+    socket_server.go handleRequest and types/application.go
+    GRPCApplication). The caller holds whatever serialization lock it wants."""
+    t = type(req).__name__
+    if t == "RequestEcho":
+        return abci.ResponseEcho(message=req.message)
+    if t == "RequestFlush":
+        return abci.ResponseFlush()
+    if t == "RequestInfo":
+        return a.info(req)
+    if t == "RequestInitChain":
+        return a.init_chain(req)
+    if t == "RequestQuery":
+        return a.query(req)
+    if t == "RequestCheckTx":
+        return a.check_tx(req)
+    if t == "RequestBeginBlock":
+        return a.begin_block(req)
+    if t == "RequestDeliverTx":
+        return a.deliver_tx(req)
+    if t == "RequestEndBlock":
+        return a.end_block(req)
+    if t == "RequestCommit":
+        return a.commit()
+    if t == "RequestPrepareProposal":
+        return a.prepare_proposal(req)
+    if t == "RequestProcessProposal":
+        return a.process_proposal(req)
+    if t == "RequestListSnapshots":
+        return a.list_snapshots(req)
+    if t == "RequestOfferSnapshot":
+        return a.offer_snapshot(req)
+    if t == "RequestLoadSnapshotChunk":
+        return a.load_snapshot_chunk(req)
+    if t == "RequestApplySnapshotChunk":
+        return a.apply_snapshot_chunk(req)
+    raise ValueError(f"unknown request {t}")
 
 
 def main(argv=None) -> int:
@@ -157,6 +164,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cometbft_tpu.abci.server")
     p.add_argument("app", choices=["kvstore", "noop"])
     p.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    p.add_argument(
+        "--transport",
+        choices=["socket", "grpc"],
+        default="socket",
+        help="process-boundary transport (abci-cli --abci flag analog)",
+    )
     p.add_argument("--snapshot-interval", type=int, default=0)
     args = p.parse_args(argv)
     if args.app == "kvstore":
@@ -165,7 +178,12 @@ def main(argv=None) -> int:
         app = KVStoreApplication(snapshot_interval=args.snapshot_interval)
     else:
         app = abci.Application()
-    srv = ABCIServer(app, args.addr)
+    if args.transport == "grpc":
+        from cometbft_tpu.abci.grpc import GrpcServer
+
+        srv = GrpcServer(app, args.addr)
+    else:
+        srv = ABCIServer(app, args.addr)
     bound = srv.start()
     print(f"ABCI server ({args.app}) listening on {bound}", flush=True)
     try:

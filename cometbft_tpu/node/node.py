@@ -271,6 +271,7 @@ class Node:
 
         # RPC (node/node.go:392 startRPC).
         self.rpc_server = None
+        self.grpc_server = None
         self._rpc_env = None
 
     # -- lifecycle ------------------------------------------------------------
@@ -351,8 +352,18 @@ class Node:
                 p2p_peers=self.switch,
             )
             self._rpc_env = env
-            self.rpc_server = JSONRPCServer(routes(env), host, port)
+            routes_map = routes(env)
+            self.rpc_server = JSONRPCServer(routes_map, host, port)
             self.rpc_server.start()
+            if self.config.rpc.grpc_laddr:
+                # node/node.go startRPC grpcListener branch: the minimal
+                # BroadcastAPI (Ping/BroadcastTx) on its own port.
+                from cometbft_tpu.rpc.grpc_server import GrpcBroadcastServer
+
+                self.grpc_server = GrpcBroadcastServer(
+                    routes_map, self.config.rpc.grpc_laddr
+                )
+                self.grpc_server.start()
 
     def stop(self) -> None:
         self.consensus_state.stop()
@@ -368,6 +379,8 @@ class Node:
         self.event_bus.stop()
         if self.rpc_server:
             self.rpc_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
 
     @property
     def rpc_port(self) -> int:
@@ -503,6 +516,10 @@ def default_new_node(config: Config, logger=None, app=None) -> Node:
         from cometbft_tpu.abci import types as abci_types
 
         creator = LocalClientCreator(abci_types.Application())
+    elif config.base.proxy_app.startswith("grpc://"):
+        from cometbft_tpu.abci.grpc import GrpcClientCreator
+
+        creator = GrpcClientCreator(config.base.proxy_app)
     else:
         from cometbft_tpu.abci.client import SocketClientCreator
 

@@ -2,8 +2,12 @@
 batch verification + Merkle hashing to any number of node processes.
 
 This is the §7 design stance ("JAX/Pallas behind a gRPC verification
-sidecar", SURVEY.md) realized for this image: grpcio is not available, so
-the transport is the same shape as the reference's ABCI socket protocol
+sidecar", SURVEY.md). The transport is deliberately NOT grpcio (although
+grpcio is importable in this image and abci/grpc.py uses it for ABCI
+parity): the sidecar sits on the consensus hot path, and the hand-framed
+protocol keeps per-call overhead to one length-prefixed write + read with
+zero HTTP/2 machinery. It is the same shape as the reference's ABCI socket
+protocol
 (abci/client/socket_client.go:529 — length-prefixed protobuf over TCP/unix,
 pipelined requests) carrying gRPC-style unary methods:
 
