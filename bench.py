@@ -101,6 +101,16 @@ def run_phase_logged(args: list, timeout_s: int, tag: str, env=None):
 # -- workload builders (host crypto is C-speed) --------------------------------
 
 
+def best_of(f, reps=3):
+    """Best wall time over reps calls, in ms."""
+    best = float("inf")
+    for _ in range(reps):
+        t1 = time.perf_counter()
+        f()
+        best = min(best, time.perf_counter() - t1)
+    return best * 1000.0
+
+
 def _signed_batch(n, tag=b"bench"):
     from cometbft_tpu.crypto import ed25519 as host_ed
 
@@ -268,14 +278,6 @@ def tpu_worker() -> None:
 
     stages = {}
 
-    def best_of(f, reps=3):
-        best = float("inf")
-        for _ in range(reps):
-            t1 = time.perf_counter()
-            f()
-            best = min(best, time.perf_counter() - t1)
-        return best * 1000.0
-
     # ---- host packing ----
     pvs, pubs, msgs, sigs = _signed_batch(N_SIGS)
     plog(f"signed {N_SIGS} messages")
@@ -332,9 +334,21 @@ def tpu_worker() -> None:
     )
     plog(f"splits: verify {stages['verify_ms']}ms merkle {stages['merkle_ms']}ms")
 
-    # ---- shipped path: VerifyCommitLight over a real commit ----
+    # ---- shipped-path configs (BASELINE #2/#4/#5) over the device backend --
+    shipped_path_stages(stages, plog, budget_left, backend="tpu")
+
+    plog(f"done on {devs[0].platform}")
+    emit(stages["combined_ms"], stages, devs[0].platform)
+
+
+def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
+    """BASELINE.md configs measured through the SHIPPED call path
+    (types/validation -> crypto.batch -> backend), shared by the TPU worker
+    and the CPU fallback so every round records them: VerifyCommitLight over
+    a real N_SIGS-validator commit, the BS_BLOCKS x BS_VALS blocksync-replay
+    shape, and a multi-hop light bisection to height 500."""
     if budget_left():
-        os.environ["CMTPU_BACKEND"] = "tpu"
+        os.environ["CMTPU_BACKEND"] = backend
         from cometbft_tpu.sidecar import backend as be
 
         be.set_backend(None)
@@ -356,10 +370,10 @@ def tpu_worker() -> None:
 
     # ---- blocksync replay: 100 blocks x 1,024-validator commits ----
     if budget_left():
-        vals1k, commits1k = _commit_fixture(BS_VALS, heights=BS_BLOCKS, tag=b"bs")
-        plog(f"blocksync fixture built ({BS_BLOCKS} x {BS_VALS})")
         from cometbft_tpu.types import validation
 
+        vals1k, commits1k = _commit_fixture(BS_VALS, heights=BS_BLOCKS, tag=b"bs")
+        plog(f"blocksync fixture built ({BS_BLOCKS} x {BS_VALS})")
         t1 = time.perf_counter()
         for h, (bid, commit) in enumerate(commits1k, start=1):
             validation.verify_commit_light("bench-chain", vals1k, bid, h, commit)
@@ -395,12 +409,11 @@ def tpu_worker() -> None:
             f"({chain.built} headers built)"
         )
 
-    plog(f"done on {devs[0].platform}")
-    emit(stages["combined_ms"], stages, devs[0].platform)
-
 
 def cpu_fallback() -> None:
-    """Stage 4: the host-tier C-speed path (what CpuBackend actually runs)."""
+    """Stage 4: the host-tier C-speed path (what CpuBackend actually runs),
+    plus the shipped-path stage configs so a device-less round still records
+    the BASELINE numbers."""
     from cometbft_tpu.crypto import ed25519
     from cometbft_tpu.crypto.merkle import hash_from_byte_slices
 
@@ -417,7 +430,15 @@ def cpu_fallback() -> None:
         best = min(best, time.perf_counter() - t1)
         assert ok
     log(f"cpu fallback best {best * 1000:.1f} ms (cryptography/OpenSSL + hashlib)")
-    emit(best * 1000.0, {}, "cpu-host")
+    stages = {}
+    t0 = time.time()
+    try:
+        shipped_path_stages(
+            stages, log, lambda: time.time() - t0 < STAGE_BUDGET_S, backend="cpu"
+        )
+    except Exception as e:  # never lose the JSON line to a stage failure
+        log(f"cpu shipped-path stages failed: {type(e).__name__}: {e}")
+    emit(best * 1000.0, stages, "cpu-host")
 
 
 def emit(measured_ms: float, stages: dict, platform: str) -> None:

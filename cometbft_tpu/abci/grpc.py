@@ -51,13 +51,14 @@ _METHODS = frozenset(
 
 
 def _strip_scheme(addr: str) -> str:
-    """grpc targets are bare host:port (or unix:path)."""
-    if addr.startswith("grpc://"):
-        return addr[len("grpc://") :]
-    if addr.startswith("tcp://"):
-        return addr[len("tcp://") :]
-    if addr.startswith("unix://"):
-        return "unix:" + addr[len("unix://") :]
+    """grpc targets are bare host:port, or unix:<abs path> for sockets. An
+    absolute path after any scheme means a unix socket (grpc:///tmp/x)."""
+    for scheme in ("grpc://", "tcp://", "unix://"):
+        if addr.startswith(scheme):
+            addr = addr[len(scheme) :]
+            break
+    if addr.startswith("/"):
+        return "unix:" + addr
     return addr
 
 
@@ -77,13 +78,14 @@ class GrpcServer:
 
     def start(self) -> str:
         target = _strip_scheme(self.addr)
+        # grpcio reports bind failure by returning port 0 instead of
+        # raising (unix sockets return 1 on success); fail fast like the
+        # socket server's bind() would.
         port = self._server.add_insecure_port(target)
-        if port == 0 and not target.startswith("unix:"):
-            # grpcio reports bind failure by returning port 0 instead of
-            # raising; fail fast like the socket server's bind() would.
+        if port == 0:
             raise OSError(f"cannot bind ABCI grpc server to {self.addr}")
         if target.startswith("unix:"):
-            self.bound = f"grpc://{target[5:]}"
+            self.bound = f"grpc://{target[5:]}"  # round-trips via _strip_scheme
         else:
             host = target.rsplit(":", 1)[0] or "127.0.0.1"
             self.bound = f"grpc://{host}:{port}"

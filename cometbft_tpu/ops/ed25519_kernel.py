@@ -121,18 +121,26 @@ def pack_batch(pubs, msgs, sigs):
         s_le[:n] = sig_arr[:, 32:]
 
     host_ok = np.zeros(nb, bool)
-    l_bytes = L.to_bytes(32, "little")
+    if n:
+        # s < L, vectorized: compare the four little-endian uint64 words
+        # most-significant first.
+        s_words = s_le[:n].view("<u8")  # [n, 4]
+        l_words = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8")
+        s_in_range = np.zeros(n, bool)
+        decided = np.zeros(n, bool)
+        for w in (3, 2, 1, 0):
+            lt = ~decided & (s_words[:, w] < l_words[w])
+            gt = ~decided & (s_words[:, w] > l_words[w])
+            s_in_range |= lt
+            decided |= lt | gt
+        # s == L (all words equal) leaves decided False -> not in range.
+        s_le[:n][~s_in_range] = 0
     k_rows = bytearray(32 * n)
+    sha512 = hashlib.sha512
     for i in range(n):
-        if not shape_ok[i]:
+        if not shape_ok[i] or not s_in_range[i]:
             continue
-        s_bytes = sigs_c[i][32:]
-        # s < L: compare little-endian byte strings most-significant first.
-        if s_bytes[::-1] >= l_bytes[::-1]:
-            s_le[i] = 0
-            continue
-        h = hashlib.sha512()
-        h.update(sigs_c[i][:32])
+        h = sha512(sigs_c[i][:32])
         h.update(pubs_c[i])
         h.update(msgs[i])
         k = int.from_bytes(h.digest(), "little") % L

@@ -119,7 +119,11 @@ def broadcast_client(addr: str, connect_timeout: float = 10.0):
     from cometbft_tpu.abci import wire as abci_wire
 
     channel = grpc.insecure_channel(addr.split("://", 1)[-1])
-    grpc.channel_ready_future(channel).result(timeout=connect_timeout)
+    try:
+        grpc.channel_ready_future(channel).result(timeout=connect_timeout)
+    except grpc.FutureTimeoutError:
+        channel.close()
+        raise ConnectionError(f"cannot connect to grpc broadcast API at {addr}")
     ping_stub = channel.unary_unary(
         f"/{_SERVICE}/Ping",
         request_serializer=lambda b: b,

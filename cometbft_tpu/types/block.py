@@ -323,6 +323,7 @@ class Commit:
     block_id: BlockID = dfield(default_factory=BlockID)
     signatures: list = dfield(default_factory=list)
     _hash: bytes | None = dfield(default=None, compare=False, repr=False)
+    _sb_cache: tuple | None = dfield(default=None, compare=False, repr=False)
 
     def size(self) -> int:
         return len(self.signatures)
@@ -337,18 +338,35 @@ class Commit:
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Reconstruct the canonical signed vote of validator val_idx
         (types/block.go:785-813) — per-sig timestamps make every batch entry
-        distinct message bytes."""
+        distinct message bytes.
+
+        Hot path: VerifyCommitLight(10k validators) calls this once per
+        signature, but type/height/round/block_id/chain_id are commit-wide
+        constants — only field 5 (timestamp) varies. The canonical prefix
+        (one per BlockIDFlag: commit block_id vs nil's dropped block_id) and
+        the chain_id suffix are built once and cached; per call this splices
+        the timestamp and re-runs only the outer length delimiter."""
         from cometbft_tpu.types import canonical
 
         cs = self.signatures[val_idx]
-        return canonical.vote_sign_bytes_from_parts(
-            chain_id,
-            PRECOMMIT_TYPE,
-            self.height,
-            self.round,
-            cs.block_id(self.block_id),
-            cs.timestamp,
-        )
+        cache = self._sb_cache
+        if cache is None or cache[0] != chain_id:
+            head = (
+                wire.field_varint(1, PRECOMMIT_TYPE)
+                + wire.field_sfixed64(2, self.height)
+                + wire.field_sfixed64(3, self.round)
+            )
+            cbid = canonical.canonical_block_id_bytes(self.block_id)
+            pre_commit = head + (
+                wire.field_message(4, cbid, emit_empty=True)
+                if cbid is not None
+                else b""
+            )
+            self._sb_cache = cache = (chain_id, pre_commit, head, wire.field_string(6, chain_id))
+        _, pre_commit, pre_nil, suffix = cache
+        prefix = pre_commit if cs.for_block_flag() else pre_nil
+        out = prefix + wire.field_message(5, cs.timestamp.encode(), emit_empty=True) + suffix
+        return wire.length_delimited(out)
 
     def encode(self) -> bytes:
         out = wire.field_varint(1, self.height)

@@ -50,6 +50,20 @@ def test_grpc_client_server_in_process():
         srv.stop()
 
 
+def test_grpc_over_unix_socket(tmp_path):
+    """The server's bound address for a unix target must be dialable by the
+    client (grpc:///path round-trips through _strip_scheme as unix:/path)."""
+    srv = GrpcServer(KVStoreApplication(), f"unix://{tmp_path}/abci-grpc.sock")
+    bound = srv.start()
+    try:
+        cli = GrpcClient(bound, connect_timeout=5.0)
+        assert cli.echo("over-unix").message == "over-unix"
+        assert cli.check_tx(abci.RequestCheckTx(tx=b"u=1")).is_ok()
+        cli.close()
+    finally:
+        srv.stop()
+
+
 def test_grpc_app_exception_surfaces_as_runtime_error():
     class Boom(abci.Application):
         def info(self, req):
