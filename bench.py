@@ -438,6 +438,19 @@ def cpu_fallback() -> None:
         )
     except Exception as e:  # never lose the JSON line to a stage failure
         log(f"cpu shipped-path stages failed: {type(e).__name__}: {e}")
+    # The axon relay flaps for hours at a time. If the tpu_watch.sh watcher
+    # captured a device run earlier (while the relay was up), attach it —
+    # clearly labeled as a previous run — so a dead-tunnel round still
+    # reports the kernel's real device number next to the CPU fallback.
+    last_device = None
+    try:
+        with open(os.path.join(HERE, "tpu_bench_latest.json")) as f:
+            last_device = json.loads(f.read().strip() or "null")
+    except (OSError, ValueError):
+        pass
+    if last_device:
+        stages["last_device_run"] = last_device
+        log(f"attaching last device run: {last_device.get('value')} ms")
     emit(best * 1000.0, stages, "cpu-host")
 
 

@@ -51,9 +51,12 @@ _METHODS = frozenset(
 
 
 def _strip_scheme(addr: str) -> str:
-    """grpc targets are bare host:port, or unix:<abs path> for sockets. An
-    absolute path after any scheme means a unix socket (grpc:///tmp/x)."""
-    for scheme in ("grpc://", "tcp://", "unix://"):
+    """grpc targets are bare host:port, or unix:<path> for sockets. unix://
+    always means a socket path (relative or absolute); for the other schemes
+    an absolute path (grpc:///tmp/x) means a unix socket too."""
+    if addr.startswith("unix://"):
+        return "unix:" + addr[len("unix://") :]
+    for scheme in ("grpc://", "tcp://"):
         if addr.startswith(scheme):
             addr = addr[len(scheme) :]
             break

@@ -18,9 +18,16 @@ WT_LEN = 2
 WT_FIXED32 = 5
 
 
+_UVARINT_1B = [bytes((v,)) for v in range(0x80)]
+
+
 def encode_uvarint(n: int) -> bytes:
-    if n < 0:
-        raise ValueError("uvarint cannot encode negative")
+    if n < 0x80:
+        # Single-byte fast path: field tags and small lengths dominate call
+        # volume on the hot sign-bytes/encode paths.
+        if n < 0:
+            raise ValueError("uvarint cannot encode negative")
+        return _UVARINT_1B[n]
     out = bytearray()
     while True:
         b = n & 0x7F
