@@ -88,7 +88,10 @@ class GrpcServer:
         if port == 0:
             raise OSError(f"cannot bind ABCI grpc server to {self.addr}")
         if target.startswith("unix:"):
-            self.bound = f"grpc://{target[5:]}"  # round-trips via _strip_scheme
+            # Keep the unix: marker in the bound address so relative socket
+            # paths round-trip through _strip_scheme too (grpc://unix:x.sock
+            # -> unix:x.sock; a bare relative path would parse as DNS).
+            self.bound = f"grpc://{target}"
         else:
             host = target.rsplit(":", 1)[0] or "127.0.0.1"
             self.bound = f"grpc://{host}:{port}"

@@ -121,12 +121,17 @@ class GenesisDoc:
     @classmethod
     def from_json(cls, s: str) -> "GenesisDoc":
         d = json.loads(s)
+        if not isinstance(d, dict):
+            raise ValueError("genesis doc must be a JSON object")
+        vals = d.get("validators") or []
+        if not isinstance(vals, list) or not all(isinstance(v, dict) for v in vals):
+            raise ValueError("genesis validators must be a list of objects")
         doc = cls(
             chain_id=d["chain_id"],
             genesis_time=Time.parse_rfc3339(d["genesis_time"]),
             initial_height=int(d.get("initial_height", 1)),
             consensus_params=_params_from_json(d.get("consensus_params")),
-            validators=[GenesisValidator.from_json(v) for v in d.get("validators") or []],
+            validators=[GenesisValidator.from_json(v) for v in vals],
             app_hash=bytes.fromhex(d.get("app_hash", "")),
             app_state=d.get("app_state"),
         )

@@ -172,11 +172,18 @@ def decode_fields(buf: bytes) -> dict[int, list]:
     return fields
 
 
+# Getters raise ValueError on wire-type confusion (a varint where bytes were
+# expected, or vice versa): adversarial inputs must fail decode cleanly, not
+# surface AttributeError/struct.error from deeper in the stack.
+
+
 def get_varint(fields: dict, num: int, default: int = 0) -> int:
     vals = fields.get(num)
     if not vals:
         return default
     v = vals[-1]
+    if not isinstance(v, int):
+        raise ValueError(f"field {num}: expected varint, got length-delimited")
     if v >= 1 << 63:
         v -= 1 << 64
     return v
@@ -184,7 +191,12 @@ def get_varint(fields: dict, num: int, default: int = 0) -> int:
 
 def get_uvarint(fields: dict, num: int, default: int = 0) -> int:
     vals = fields.get(num)
-    return vals[-1] if vals else default
+    if not vals:
+        return default
+    v = vals[-1]
+    if not isinstance(v, int):
+        raise ValueError(f"field {num}: expected varint, got length-delimited")
+    return v
 
 
 def get_bool(fields: dict, num: int) -> bool:
@@ -193,23 +205,42 @@ def get_bool(fields: dict, num: int) -> bool:
 
 def get_bytes(fields: dict, num: int, default: bytes = b"") -> bytes:
     vals = fields.get(num)
-    return vals[-1] if vals else default
+    if not vals:
+        return default
+    v = vals[-1]
+    if not isinstance(v, bytes):
+        raise ValueError(f"field {num}: expected length-delimited, got varint")
+    return v
 
 
 def get_string(fields: dict, num: int, default: str = "") -> str:
     vals = fields.get(num)
-    return vals[-1].decode("utf-8") if vals else default
+    if not vals:
+        return default
+    v = vals[-1]
+    if not isinstance(v, bytes):
+        raise ValueError(f"field {num}: expected length-delimited, got varint")
+    try:
+        return v.decode("utf-8")
+    except UnicodeDecodeError:
+        raise ValueError(f"field {num}: invalid utf-8 string")
 
 
 def get_sfixed64(fields: dict, num: int, default: int = 0) -> int:
     vals = fields.get(num)
     if not vals:
         return default
-    return struct.unpack("<q", vals[-1])[0]
+    v = vals[-1]
+    if not isinstance(v, bytes) or len(v) != 8:
+        raise ValueError(f"field {num}: expected fixed64")
+    return struct.unpack("<q", v)[0]
 
 
 def get_repeated_bytes(fields: dict, num: int) -> list[bytes]:
-    return list(fields.get(num, []))
+    vals = fields.get(num, [])
+    if any(not isinstance(v, bytes) for v in vals):
+        raise ValueError(f"field {num}: expected length-delimited, got varint")
+    return list(vals)
 
 
 def get_repeated_uvarint(fields: dict, num: int) -> list[int]:

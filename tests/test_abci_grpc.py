@@ -52,13 +52,26 @@ def test_grpc_client_server_in_process():
 
 def test_grpc_over_unix_socket(tmp_path):
     """The server's bound address for a unix target must be dialable by the
-    client (grpc:///path round-trips through _strip_scheme as unix:/path)."""
+    client — for absolute AND relative socket paths (a bare relative path
+    would parse as a DNS name)."""
     srv = GrpcServer(KVStoreApplication(), f"unix://{tmp_path}/abci-grpc.sock")
     bound = srv.start()
     try:
         cli = GrpcClient(bound, connect_timeout=5.0)
         assert cli.echo("over-unix").message == "over-unix"
         assert cli.check_tx(abci.RequestCheckTx(tx=b"u=1")).is_ok()
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_grpc_over_relative_unix_socket(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    srv = GrpcServer(KVStoreApplication(), "unix://rel-abci.sock")
+    bound = srv.start()
+    try:
+        cli = GrpcClient(bound, connect_timeout=5.0)
+        assert cli.echo("rel").message == "rel"
         cli.close()
     finally:
         srv.stop()

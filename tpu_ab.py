@@ -1,0 +1,98 @@
+"""A/B the field-multiply lowerings on the real device (run by tpu_watch.sh
+after a successful bench, or by hand when the relay is up).
+
+For each CMTPU_FE_MODE in (stacked, compact, planar) spawn a fresh worker
+process (the mode is sampled at import) that compiles the 10,240-lane verify
+program and times steady-state dispatches. planar goes last under a hard
+timeout: its compile has never finished on the device (>8 min observed) and
+a hang must not eat the tunnel-up window.
+
+Appends one JSON line per mode to stdout; tpu_watch.sh redirects to
+tpu_ab.log.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+N = int(os.environ.get("CMTPU_AB_SIGS", "10240"))
+MODES = (("stacked", 600), ("compact", 600), ("planar", 420))
+
+
+def worker(mode: str) -> None:
+    t0 = time.time()
+
+    def log(msg):
+        print(f"[ab:{mode} {time.time() - t0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    import numpy as np
+
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    devs = jax.devices()
+    log(f"devices: {devs}")
+    operands, _ = ek.pack_batch([b"\x00" * 32] * N, [b""] * N, [b"\x00" * 64] * N)
+    log("packed")
+    t1 = time.time()
+    fn = jax.jit(ek.verify_core)
+    jax.block_until_ready(fn(*operands))
+    compile_s = time.time() - t1
+    log(f"first dispatch {compile_s:.1f}s")
+    best = float("inf")
+    for _ in range(3):
+        t1 = time.perf_counter()
+        jax.block_until_ready(fn(*operands))
+        best = min(best, time.perf_counter() - t1)
+    log(f"steady {best * 1000:.1f} ms")
+    print(
+        json.dumps(
+            {
+                "mode": mode,
+                "n": N,
+                "platform": devs[0].platform,
+                "first_dispatch_s": round(compile_s, 2),
+                "steady_ms": round(best * 1000, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main() -> int:
+    for mode, tmo in MODES:
+        env = {**os.environ, "CMTPU_FE_MODE": mode}
+        try:
+            out = subprocess.run(
+                [sys.executable, "-u", __file__, "--worker"],
+                env=env,
+                timeout=tmo,
+                capture_output=True,
+                text=True,
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+            if out.returncode != 0:
+                tail = (out.stderr or "").strip().splitlines()[-3:]
+                print(
+                    json.dumps({"mode": mode, "error": f"rc={out.returncode}", "tail": tail}),
+                    flush=True,
+                )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"mode": mode, "error": f"timeout>{tmo}s"}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker(os.environ.get("CMTPU_FE_MODE", "auto"))
+    else:
+        sys.exit(main())

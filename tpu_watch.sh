@@ -51,6 +51,16 @@ for i in $(seq 1 1400); do
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
       log "device bench OK -> tpu_bench_latest.json"
       echo "OK $(date +%s)" > .tpu_status
+      # While the tunnel is up, also A/B the fe lowerings (guides the next
+      # kernel iteration even if the tunnel dies later). Re-run until at
+      # least the two tractable modes (stacked, compact) each produced a
+      # steady_ms line — a partial run (tunnel died mid-probe) retries;
+      # planar timing out forever must not retrigger the probe.
+      if [ "$(grep -c steady_ms tpu_ab.log 2>/dev/null)" -lt 2 ]; then
+        log "running fe-lowering A/B probe"
+        timeout 1800 python -u tpu_ab.py >> tpu_ab.log 2>> tpu_watch.log
+        log "A/B probe done"
+      fi
       sleep 1800
     else
       echo POLLING > .tpu_status
