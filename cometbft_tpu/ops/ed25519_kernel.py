@@ -11,10 +11,13 @@ in SPMD lockstep, so one device call yields the exact per-signature validity
 bitmap the callers need (types/validation.go:234-249) with no re-runs.
 
 Host side: SHA-512 challenge hashing of the variable-length messages
-(hashlib, C speed), s-range checks, and limb/signed-digit packing (numpy).
-Device side: decompression, the signed-4-bit-window double-scalar ladder
-(edwards.windowed_double_base_mult), and the identity test — one
-jit-compiled program per batch-size bucket.
+(hashlib, C speed) and s-range checks — nothing else. The kernel takes the
+RAW 32/64-byte encodings as little-endian uint32 words (128 bytes per
+signature over the host->device link) and unpacks on device: point
+y-limbs/sign, k = digest mod L, and the signed-window digit recode
+(ops/unpack.py). Device side: decompression, the signed-4-bit-window
+double-scalar ladder (edwards.windowed_double_base_mult), and the identity
+test — one jit-compiled program per batch-size bucket.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import jax.numpy as jnp
 
 from cometbft_tpu.ops import edwards as ed
 from cometbft_tpu.ops import field25519 as fe
+from cometbft_tpu.ops import unpack
 
 L = 2**252 + 27742317777372353535851937790883648493
 
@@ -44,14 +48,19 @@ def bucket_for(n: int) -> int:
     return int(2 ** np.ceil(np.log2(n)))
 
 
-def verify_core(y_a, sign_a, y_r, sign_r, s_digits, k_digits):
-    """Pure jittable core: limbs/signed digits in, bool[N] out. The A and R
-    decompressions ride ONE width-2N pass (lane-stacked) — same op count in
-    half the program. Straight-line sections compile with the COMPACT field
-    multiply (decompression's inversion chain is ~280 muls of ~3,300 total:
-    a planar lowering there would double compile time for a few percent of
-    runtime); the loop-rolled window ladder keeps the planar lowering."""
-    n = y_a.shape[1]
+def verify_core(a_words, r_words, s_words, k_words):
+    """Pure jittable core: raw little-endian words in (A, R as int32[8, N];
+    S as int32[8, N]; the SHA-512 challenge as int32[16, N]), bool[N] out.
+    Unpacking (limbs, mod L, digit recode) happens on device first; the A
+    and R decompressions then ride ONE width-2N pass (lane-stacked) — same
+    op count in half the program. Straight-line sections use compact_scope
+    (meaningful only under the opt-in planar lowering; a no-op for the
+    default stacked form)."""
+    n = a_words.shape[1]
+    y_a, sign_a = unpack.words_to_limbs255(a_words)
+    y_r, sign_r = unpack.words_to_limbs255(r_words)
+    s_digits = unpack.scalar_words_to_digits(s_words)
+    k_digits = unpack.digest_words_to_digits(k_words)
     with fe.compact_scope():
         y2 = jnp.concatenate([y_a, y_r], axis=1)
         sg2 = jnp.concatenate([sign_a, sign_r])
@@ -89,17 +98,10 @@ def warmup(buckets=(128, 1024, 10240), merkle_leaves=(1024, 65536)) -> None:
         jax.block_until_ready(mk._leaves_to_root_jit(1, n)(blocks, nblocks))
 
 
-def _split_enc(enc: np.ndarray):
-    """uint8[N,32] point encodings -> (y limbs int32[17,N] — bit 255 dropped
-    by the packer — and the sign bit bool[N])."""
-    limbs = fe.fe_from_bytes_le(enc)
-    sign = (enc[:, 31] >> 7).astype(bool)
-    return limbs, sign
-
-
 def pack_batch(pubs, msgs, sigs):
-    """Host-side packing of one verification batch: vectorized numpy for
-    everything but the per-signature SHA-512 challenge (hashlib, C speed).
+    """Host-side packing of one verification batch: per-signature SHA-512
+    challenges (hashlib, C speed), the vectorized s < L check, and raw-byte
+    -> word views — all limb/digit work happens on device (ops/unpack.py).
     Returns device operands plus the host-decided validity mask (shape
     errors, s >= L). Invalid entries are packed as zeros — lanes the device
     evaluates but the mask vetoes."""
@@ -113,7 +115,7 @@ def pack_batch(pubs, msgs, sigs):
     a_enc = np.zeros((nb, 32), np.uint8)
     r_enc = np.zeros((nb, 32), np.uint8)
     s_le = np.zeros((nb, 32), np.uint8)
-    k_le = np.zeros((nb, 32), np.uint8)
+    k_le = np.zeros((nb, 64), np.uint8)
     if n:
         a_enc[:n] = np.frombuffer(b"".join(pubs_c), np.uint8).reshape(n, 32)
         sig_arr = np.frombuffer(b"".join(sigs_c), np.uint8).reshape(n, 64)
@@ -135,7 +137,7 @@ def pack_batch(pubs, msgs, sigs):
             decided |= lt | gt
         # s == L (all words equal) leaves decided False -> not in range.
         s_le[:n][~s_in_range] = 0
-    k_rows = bytearray(32 * n)
+    digest_rows = bytearray(64 * n)
     sha512 = hashlib.sha512
     for i in range(n):
         if not shape_ok[i] or not s_in_range[i]:
@@ -143,17 +145,17 @@ def pack_batch(pubs, msgs, sigs):
         h = sha512(sigs_c[i][:32])
         h.update(pubs_c[i])
         h.update(msgs[i])
-        k = int.from_bytes(h.digest(), "little") % L
-        k_rows[32 * i : 32 * (i + 1)] = k.to_bytes(32, "little")
+        digest_rows[64 * i : 64 * (i + 1)] = h.digest()
         host_ok[i] = True
     if n:
-        k_le[:n] = np.frombuffer(bytes(k_rows), np.uint8).reshape(n, 32)
+        k_le[:n] = np.frombuffer(bytes(digest_rows), np.uint8).reshape(n, 64)
 
-    y_a, sign_a = _split_enc(a_enc)
-    y_r, sign_r = _split_enc(r_enc)
-    s_digits = ed.scalars_to_digits(s_le)
-    k_digits = ed.scalars_to_digits(k_le)
-    return (y_a, sign_a, y_r, sign_r, s_digits, k_digits), host_ok
+    return (
+        unpack.bytes_to_words(a_enc),
+        unpack.bytes_to_words(r_enc),
+        unpack.bytes_to_words(s_le),
+        unpack.bytes_to_words(k_le),
+    ), host_ok
 
 
 def batch_verify(pubs, msgs, sigs) -> tuple[bool, list]:

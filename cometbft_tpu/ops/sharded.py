@@ -28,14 +28,13 @@ def make_mesh(devices=None, axis: str = "sig") -> Mesh:
 
 def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     """jit-compiled batch verify with operands sharded over the batch dim
-    (limb arrays are [17, N]: shard N). Returns ok bool[N] (sharded)."""
+    (raw word arrays are [8|16, N]: shard N — 128 bytes/sig crosses the
+    interconnect, unpacking runs shard-local on device). Returns ok bool[N]
+    (sharded)."""
     shard_n = NamedSharding(mesh, P(None, axis))
-    in_shardings = (shard_n, NamedSharding(mesh, P(axis)),
-                    shard_n, NamedSharding(mesh, P(axis)),
-                    shard_n, shard_n)
     return jax.jit(
         ek.verify_core,
-        in_shardings=in_shardings,
+        in_shardings=(shard_n,) * 4,
         out_shardings=NamedSharding(mesh, P(axis)),
     )
 
@@ -76,8 +75,8 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
     sharded signature batch AND reduces a sharded Merkle leaf forest, with a
     psum for the all-valid bit."""
 
-    def step(y_a, sign_a, y_r, sign_r, s_bits, k_bits, leaf_digests):
-        ok = ek.verify_core(y_a, sign_a, y_r, sign_r, s_bits, k_bits)
+    def step(a_words, r_words, s_words, k_words, leaf_digests):
+        ok = ek.verify_core(a_words, r_words, s_words, k_words)
 
         def reduce_shard(ok_shard, leaf_shard):
             local_ok = jnp.all(ok_shard).astype(jnp.int32)
@@ -98,11 +97,7 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
         return ok, all_valid, root_cols[:, :1]
 
     shard_n = NamedSharding(mesh, P(None, axis))
-    shard_1 = NamedSharding(mesh, P(axis))
-    return jax.jit(
-        step,
-        in_shardings=(shard_n, shard_1, shard_n, shard_1, shard_n, shard_n, shard_n),
-    )
+    return jax.jit(step, in_shardings=(shard_n,) * 5)
 
 
 def make_example_batch(n: int):

@@ -1,0 +1,198 @@
+"""Device-side operand unpacking for the ed25519 verify kernel.
+
+Round-3's kernel took host-packed limbs and signed digits: ~650 bytes per
+signature over the host->device link and ~20 ms of numpy/bigint work per
+10k batch. This module moves everything after SHA-512 onto the device —
+the kernel now takes the RAW encodings (A, R, S as 8 little-endian uint32
+words per 32-byte string; the 64-byte SHA-512 challenge as 16 words), i.e.
+128 bytes per signature, and computes on-chip:
+
+  - point y-limbs + sign bit        (words_to_limbs255)
+  - s -> signed 4-bit window digits (scalar_words_to_digits)
+  - k = digest mod L -> digits      (digest_words_to_digits)
+
+The mod-L reduction uses 12-bit limbs so every schoolbook product fits
+int32 (24-bit products, column sums < 2^28.3), folding with
+2^252 = -c (mod L), c = L - 2^252 (125 bits). Negative intermediates are
+avoided by adding a precomputed multiple of L before each subtraction
+(R = lo + (M - hi*c)); three folds bring 512 bits to < lo_max + L < 2L,
+then one conditional subtract of L finishes. The signed-window recode is
+the same add-8s identity the host packer used (see edwards.scalars_to_
+digits), done limb-wise with an unrolled carry chain.
+
+All functions trace into the verify program: a few hundred [N]-wide int32
+ops, negligible next to the window ladder, compiled once per bucket.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import field25519 as fe
+
+L = 2**252 + 27742317777372353535851937790883648493
+C = L - 2**252  # 125 bits
+
+_LB = 12  # limb bits for the scalar arithmetic
+_LMASK = (1 << _LB) - 1
+
+
+def _int_to_limbs12(v: int, n: int) -> np.ndarray:
+    return np.array([(v >> (_LB * i)) & _LMASK for i in range(n)], np.int32)
+
+
+_C_LIMBS = _int_to_limbs12(C, 11)
+# Multiples of L with headroom for each fold's subtraction (see module doc).
+_M1_LIMBS = _int_to_limbs12(L << 140, 33)  # >= max D1 = 2^264 * c < 2^389
+_M2_LIMBS = _int_to_limbs12(L << 15, 23)  # >= max D2 = 2^141 * c < 2^266
+_M3_LIMBS = _int_to_limbs12(L, 22)  # >= max D3 = 2^25 * c < 2^150
+_L_LIMBS = _int_to_limbs12(L, 22)
+_EIGHTS_LIMBS = _int_to_limbs12(int("8" * 64, 16), 22)
+
+
+def bytes_to_words(b: np.ndarray) -> np.ndarray:
+    """uint8[N, 4k] little-endian -> int32[k, N] holding the uint32 words
+    (host-side zero-copy-ish view + transpose)."""
+    w = np.ascontiguousarray(b, np.uint8).view("<u4")  # [N, k]
+    return np.ascontiguousarray(w.T).astype(np.int32)  # int32 BIT pattern
+
+
+def _u(w):
+    return w.astype(jnp.uint32)
+
+
+def words_to_limbs255(w: jnp.ndarray):
+    """int32[8, N] words -> (int32[17, N] 15-bit limbs of bits 0..254,
+    bool[N] sign = bit 255). Device analog of fe.fe_from_bytes_le."""
+    wu = _u(w)
+    limbs = []
+    for i in range(fe.LIMBS):
+        lo_bit = 15 * i
+        j, off = divmod(lo_bit, 32)
+        v = wu[j] >> np.uint32(off)
+        if off > 32 - 15 and j + 1 < 8:
+            v = v | (wu[j + 1] << np.uint32(32 - off))
+        limbs.append((v & np.uint32(0x7FFF)).astype(jnp.int32))
+    sign = (wu[7] >> np.uint32(31)) == 1
+    return jnp.stack(limbs), sign
+
+
+def _words_to_limbs12(w: jnp.ndarray, nbits: int) -> list:
+    """int32[k, N] uint32 words -> list of int32[N] 12-bit limbs covering
+    nbits bits."""
+    wu = _u(w)
+    nwords = w.shape[0]
+    out = []
+    for i in range((nbits + _LB - 1) // _LB):
+        lo_bit = _LB * i
+        j, off = divmod(lo_bit, 32)
+        v = wu[j] >> np.uint32(off)
+        if off > 32 - _LB and j + 1 < nwords:
+            v = v | (wu[j + 1] << np.uint32(32 - off))
+        out.append((v & np.uint32(_LMASK)).astype(jnp.int32))
+    return out
+
+
+def _carry_seq(limbs: list, nout: int) -> list:
+    """Sequential signed carry chain: normalize to nout limbs in [0, 2^12).
+    Arithmetic >> keeps negative intermediates correct (q = v >> 12 floors,
+    r = v - (q << 12) is always in range). The overall value must be
+    non-negative and fit nout limbs; the final carry folds into the top."""
+    out = []
+    carry = None
+    for i in range(nout):
+        v = limbs[i] if i < len(limbs) else None
+        if v is None:
+            v = carry
+        elif carry is not None:
+            v = v + carry
+        if v is None:
+            out.append(jnp.zeros_like(limbs[0]))
+            continue
+        q = v >> _LB
+        out.append(v - (q << _LB))
+        carry = q
+    return out
+
+
+def _mul_limbs(a: list, b_const: np.ndarray) -> list:
+    """Schoolbook a * b_const over 12-bit limbs -> unnormalized columns
+    (each < len(b) * 2^24 < 2^28.3, int32-safe)."""
+    cols = [None] * (len(a) + len(b_const))
+    for j, bj in enumerate(b_const):
+        bj = int(bj)
+        if bj == 0:
+            continue
+        for i, ai in enumerate(a):
+            p = ai * bj
+            cols[i + j] = p if cols[i + j] is None else cols[i + j] + p
+    return [c if c is not None else None for c in cols]
+
+
+def _fold(limbs: list, m_limbs: np.ndarray, nout: int) -> list:
+    """One reduction round: split at limb 21 (bit 252), return
+    lo + (M - hi*c) carried to nout limbs."""
+    lo, hi = limbs[:21], limbs[21:]
+    d = _mul_limbs(hi, _C_LIMBS)
+    acc = []
+    for i in range(nout):
+        v = None
+        if i < len(lo):
+            v = lo[i]
+        if i < len(m_limbs) and m_limbs[i]:
+            mv = jnp.int32(int(m_limbs[i]))
+            v = mv if v is None else v + mv
+        if i < len(d) and d[i] is not None:
+            v = -d[i] if v is None else v - d[i]
+        acc.append(v if v is not None else jnp.zeros_like(limbs[0]))
+    return _carry_seq(acc, nout)
+
+
+def _cond_sub_l(limbs: list) -> list:
+    """limbs (22, value < 2L) -> value mod L via one conditional subtract."""
+    diff = []
+    borrow = None
+    for i in range(22):
+        v = limbs[i] - int(_L_LIMBS[i])
+        if borrow is not None:
+            v = v + borrow
+        q = v >> _LB  # 0 or -1
+        diff.append(v - (q << _LB))
+        borrow = q
+    ge = borrow == 0  # no final borrow -> value >= L
+    return [jnp.where(ge, d, o) for d, o in zip(diff, limbs)]
+
+
+def _limbs_to_digits(limbs: list) -> jnp.ndarray:
+    """22 12-bit limbs (value < 2^253) -> int32[64, N] signed radix-16
+    digits in [-8, 7] via the add-8s identity (t = v + 0x88..8; nibble - 8),
+    matching edwards.scalars_to_digits bit for bit."""
+    t = [limbs[i] + int(_EIGHTS_LIMBS[i]) for i in range(22)]
+    t = _carry_seq(t, 22)
+    digits = []
+    for d in range(64):
+        lo_bit = 4 * d
+        j, off = divmod(lo_bit, _LB)
+        v = t[j] >> off
+        if off > _LB - 4 and j + 1 < 22:
+            v = v | (t[j + 1] << (_LB - off))
+        digits.append((v & 15) - 8)
+    return jnp.stack(digits)
+
+
+def scalar_words_to_digits(w: jnp.ndarray) -> jnp.ndarray:
+    """int32[8, N] words of s (< L, host-checked) -> signed digits [64, N]."""
+    limbs = _words_to_limbs12(w, 256)  # 22 limbs
+    return _limbs_to_digits(limbs)
+
+
+def digest_words_to_digits(w: jnp.ndarray) -> jnp.ndarray:
+    """int32[16, N] words of the 64-byte SHA-512 challenge -> signed digits
+    of (digest mod L), entirely on device."""
+    limbs = _words_to_limbs12(w, 512)  # 43 limbs
+    r1 = _fold(limbs, _M1_LIMBS, 33)
+    r2 = _fold(r1, _M2_LIMBS, 23)
+    r3 = _fold(r2, _M3_LIMBS, 22)  # < lo_max + L < 2L
+    return _limbs_to_digits(_cond_sub_l(r3))

@@ -162,8 +162,9 @@ def test_fuzz_decoders_do_not_accept_bitflipped_signatures():
             continue
         if mutated == v:  # flip landed in unparsed padding; irrelevant
             continue
+        verified = True
         try:
             mutated.verify("fuzz-chain", priv.pub_key())
-            assert False, f"bit flip at byte {i} still verifies"
         except Exception:
-            pass
+            verified = False
+        assert not verified, f"bit flip at byte {i} still verifies"

@@ -56,7 +56,7 @@ for i in $(seq 1 1400); do
       # least the two tractable modes (stacked, compact) each produced a
       # steady_ms line — a partial run (tunnel died mid-probe) retries;
       # planar timing out forever must not retrigger the probe.
-      if [ "$(grep -c steady_ms tpu_ab.log 2>/dev/null)" -lt 2 ]; then
+      if [ ! -f tpu_ab.log ] || [ "$(grep -c steady_ms tpu_ab.log)" -lt 2 ]; then
         log "running fe-lowering A/B probe"
         timeout 1800 python -u tpu_ab.py >> tpu_ab.log 2>> tpu_watch.log
         log "A/B probe done"
