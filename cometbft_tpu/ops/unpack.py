@@ -44,11 +44,11 @@ def _int_to_limbs12(v: int, n: int) -> np.ndarray:
 
 
 _C_LIMBS = _int_to_limbs12(C, 11)
+_L_LIMBS = _int_to_limbs12(L, 22)
 # Multiples of L with headroom for each fold's subtraction (see module doc).
 _M1_LIMBS = _int_to_limbs12(L << 140, 33)  # >= max D1 = 2^264 * c < 2^389
 _M2_LIMBS = _int_to_limbs12(L << 15, 23)  # >= max D2 = 2^141 * c < 2^266
-_M3_LIMBS = _int_to_limbs12(L, 22)  # >= max D3 = 2^25 * c < 2^150
-_L_LIMBS = _int_to_limbs12(L, 22)
+_M3_LIMBS = _L_LIMBS  # >= max D3 = 2^16 * c < 2^141
 _EIGHTS_LIMBS = _int_to_limbs12(int("8" * 64, 16), 22)
 
 
@@ -128,7 +128,7 @@ def _mul_limbs(a: list, b_const: np.ndarray) -> list:
         for i, ai in enumerate(a):
             p = ai * bj
             cols[i + j] = p if cols[i + j] is None else cols[i + j] + p
-    return [c if c is not None else None for c in cols]
+    return cols
 
 
 def _fold(limbs: list, m_limbs: np.ndarray, nout: int) -> list:
@@ -173,12 +173,9 @@ def _limbs_to_digits(limbs: list) -> jnp.ndarray:
     t = _carry_seq(t, 22)
     digits = []
     for d in range(64):
-        lo_bit = 4 * d
-        j, off = divmod(lo_bit, _LB)
-        v = t[j] >> off
-        if off > _LB - 4 and j + 1 < 22:
-            v = v | (t[j + 1] << (_LB - off))
-        digits.append((v & 15) - 8)
+        # 4 divides 12, so a nibble never straddles limbs.
+        j, off = divmod(4 * d, _LB)
+        digits.append(((t[j] >> off) & 15) - 8)
     return jnp.stack(digits)
 
 

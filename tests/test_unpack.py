@@ -1,0 +1,55 @@
+"""Device-side operand unpacking (ops/unpack.py) pinned against bigint
+ground truth — every stage bit-for-bit, with the mod-L boundary cases that
+random e2e batches would only hit probabilistically."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from cometbft_tpu.ops import edwards as ed
+from cometbft_tpu.ops import field25519 as fe
+from cometbft_tpu.ops import unpack
+
+L = unpack.L
+
+
+def test_words_to_limbs255_matches_host_packer():
+    rng = np.random.default_rng(7)
+    b = rng.integers(0, 256, size=(64, 32), dtype=np.uint8)
+    limbs, sign = unpack.words_to_limbs255(jnp.asarray(unpack.bytes_to_words(b)))
+    assert np.array_equal(np.asarray(limbs), fe.fe_from_bytes_le(b))
+    assert np.array_equal(np.asarray(sign), (b[:, 31] >> 7).astype(bool))
+
+
+def test_scalar_words_to_digits_matches_host_recode():
+    rng = np.random.default_rng(8)
+    s = rng.integers(0, 256, size=(64, 32), dtype=np.uint8)
+    s[:, 31] &= 0x1F  # < 2^253, the ladder's contract
+    s[0] = 0
+    s[1] = np.frombuffer((2**253 - 1).to_bytes(32, "little"), np.uint8)
+    got = np.asarray(
+        unpack.scalar_words_to_digits(jnp.asarray(unpack.bytes_to_words(s)))
+    )
+    assert np.array_equal(got, ed.scalars_to_digits(s))
+
+
+def test_digest_mod_l_boundaries_and_random():
+    rng = np.random.default_rng(9)
+    cases = [rng.integers(0, 256, size=64, dtype=np.uint8).tobytes() for _ in range(300)]
+    cases += [
+        v.to_bytes(64, "little")
+        for v in (0, 1, L - 1, L, L + 1, 2 * L - 1, 2 * L,
+                  2**252 - 1, 2**252, 2**252 + 1,
+                  2**512 - 1, 2**511, (L << 259), (L << 140) - 1)
+    ]
+    arr = np.frombuffer(b"".join(cases), np.uint8).reshape(len(cases), 64)
+    got = np.asarray(
+        unpack.digest_words_to_digits(jnp.asarray(unpack.bytes_to_words(arr)))
+    )
+    for i, c in enumerate(cases):
+        k = int.from_bytes(c, "little") % L
+        want = ed.scalars_to_digits(
+            np.frombuffer(k.to_bytes(32, "little"), np.uint8).reshape(1, 32)
+        )
+        assert np.array_equal(got[:, i : i + 1], want), f"case {i} (k={k:#x})"
