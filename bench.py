@@ -334,6 +334,14 @@ def tpu_worker() -> None:
     )
     plog(f"splits: verify {stages['verify_ms']}ms merkle {stages['merkle_ms']}ms")
 
+    # ---- BASELINE #3 tail: inclusion proofs for every tx (proof.go:35) ----
+    if budget_left():
+        mk.proofs_aunts_device(txs)  # warm the all-levels program
+        stages["merkle_proofs_ms"] = round(
+            best_of(lambda: mk.proofs_aunts_device(txs), reps=2), 1
+        )
+        plog(f"proofs (device levels + aunts): {stages['merkle_proofs_ms']} ms")
+
     # ---- shipped-path configs (BASELINE #2/#4/#5) over the device backend --
     shipped_path_stages(stages, plog, budget_left, backend="tpu")
 
@@ -383,6 +391,16 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             f"blocksync replay {dt:.1f}s "
             f"({stages['blocksync_replay_ms_per_block']} ms/block)"
         )
+
+    # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
+    if budget_left() and backend == "cpu":
+        from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
+
+        txs = [b"bench-tx-%08d" % i for i in range(N_LEAVES)]
+        stages["merkle_proofs_ms"] = round(
+            best_of(lambda: proofs_from_byte_slices(txs), reps=2), 1
+        )
+        plog(f"proofs (host) @{N_LEAVES}: {stages['merkle_proofs_ms']} ms")
 
     # ---- light-client bisection to height 500 over 4,096-val sets ----
     if budget_left():

@@ -115,8 +115,12 @@ def pack_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(words.transpose(1, 2, 0)), nblocks
 
 
+def digest_words_to_arr(words: np.ndarray) -> np.ndarray:
+    """uint32[8, N] -> uint8[N, 32] big-endian digests (host, vectorized)."""
+    w = np.asarray(words).T.astype(">u4")  # [N, 8]
+    return np.ascontiguousarray(w).view(np.uint8).reshape(w.shape[0], 32)
+
+
 def digest_words_to_bytes(words: np.ndarray) -> list[bytes]:
     """uint32[8, N] -> N 32-byte big-endian digests (host)."""
-    w = np.asarray(words).T.astype(">u4")  # [N, 8]
-    flat = np.ascontiguousarray(w).view(np.uint8).reshape(w.shape[0], 32)
-    return [bytes(row) for row in flat]
+    return [bytes(row) for row in digest_words_to_arr(words)]

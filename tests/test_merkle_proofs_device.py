@@ -1,0 +1,44 @@
+"""Device-computed inclusion proofs (ops/merkle_kernel.proofs_from_byte_
+slices_device) must equal the host crypto/merkle.ProofsFromByteSlices
+recursion exactly — totals, indexes, leaf hashes, aunts — for power-of-two
+AND odd-promotion sizes, and every proof must verify against the root."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
+from cometbft_tpu.ops import merkle_kernel as mk
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8, 13, 64, 100, 255, 256])
+def test_device_proofs_equal_host(n):
+    txs = [b"t-%d" % i for i in range(n)]
+    root_h, proofs_h = proofs_from_byte_slices(txs)
+    root_d, proofs_d = mk.proofs_from_byte_slices_device(txs)
+    assert root_h == root_d
+    assert len(proofs_d) == n
+    for i in range(n):
+        ph, pd = proofs_h[i], proofs_d[i]
+        assert (ph.total, ph.index) == (pd.total, pd.index)
+        assert ph.leaf_hash == pd.leaf_hash
+        assert ph.aunts == pd.aunts
+        assert pd.verify(root_d, txs[i]) is None
+
+
+def test_device_proofs_reject_cross_tree():
+    txs = [b"x-%d" % i for i in range(8)]
+    root, proofs = mk.proofs_from_byte_slices_device(txs)
+    other_root, _ = mk.proofs_from_byte_slices_device([b"y"])
+    with pytest.raises(ValueError):
+        proofs[0].verify(other_root, txs[0])
+
+
+def test_device_proofs_lazy_sequence_protocol():
+    txs = [b"s-%d" % i for i in range(5)]
+    _, proofs = mk.proofs_from_byte_slices_device(txs)
+    assert len(list(proofs)) == 5
+    assert [p.index for p in proofs[1:3]] == [1, 2]
+    assert proofs[-1].index == 4
+    with pytest.raises(IndexError):
+        proofs[5]
