@@ -101,6 +101,82 @@ def run_phase_logged(args: list, timeout_s: int, tag: str, env=None):
 # -- workload builders (host crypto is C-speed) --------------------------------
 
 
+def _devnet_throughput(seconds: float = 12.0, n_vals: int = 4):
+    """System-level stage: an in-process 4-validator devnet over real TCP
+    (SecretConnection, gossip, mempool) under continuous tx load. Returns
+    (blocks/s, committed tx/s) — the analog of the reference's QA
+    saturation measurements (docs/qa/: ~0.7 blocks/s, ~400 tx/s on a
+    200-node DigitalOcean testnet; here everything shares one host)."""
+    import threading
+
+    from cometbft_tpu.abci.client import LocalClientCreator
+    from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import cmttime
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    pvs = [FilePV(ed25519.gen_priv_key_from_secret(b"bench-val-%d" % i)) for i in range(n_vals)]
+    gen = GenesisDoc(
+        chain_id="bench-devnet",
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+    nodes = []
+    for pv in pvs:
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        nodes.append(Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication())))
+    try:
+        for nd in nodes:
+            nd.start()
+        addrs = [nd.switch.node_info.listen_addr for nd in nodes]
+        for i, nd in enumerate(nodes):
+            for j, a in enumerate(addrs):
+                if i != j:
+                    nd.switch.dial_peer(a)
+        stop = [False]
+
+        def pump():
+            k = 0
+            while not stop[0]:
+                for nd in nodes:
+                    try:
+                        nd.mempool.check_tx(b"bench%d=v" % k)
+                    except Exception:
+                        pass
+                k += 1
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+        t0 = time.time()
+        h0 = nodes[0].block_store.height()  # committed-height semantics
+        time.sleep(seconds)
+        stop[0] = True
+        dt = time.time() - t0
+        h1 = nodes[0].block_store.height()
+        txs = 0
+        for h in range(h0 + 1, h1 + 1):
+            blk = nodes[0].block_store.load_block(h)
+            if blk is not None:
+                txs += len(blk.data.txs)
+        return (h1 - h0) / dt, txs / dt
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:
+                pass
+
+
 def best_of(f, reps=3):
     """Best wall time over reps calls, in ms."""
     best = float("inf")
@@ -401,6 +477,16 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             best_of(lambda: proofs_from_byte_slices(txs), reps=2), 1
         )
         plog(f"proofs (host) @{N_LEAVES}: {stages['merkle_proofs_ms']} ms")
+
+    # ---- system level: 4-validator devnet over real TCP, tx throughput ----
+    if budget_left():
+        try:
+            bps, tps = _devnet_throughput(seconds=12)
+            stages["devnet_blocks_per_s"] = round(bps, 2)
+            stages["devnet_tx_per_s"] = round(tps, 1)
+            plog(f"devnet: {bps:.2f} blocks/s, {tps:.0f} tx/s (4 vals, TCP)")
+        except Exception as e:
+            plog(f"devnet stage failed: {type(e).__name__}: {e}")
 
     # ---- light-client bisection to height 500 over 4,096-val sets ----
     if budget_left():

@@ -144,6 +144,7 @@ def _verify_commit_batch(
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0
+    all_sign_bytes = commit.vote_sign_bytes_all(chain_id)
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue
@@ -158,8 +159,7 @@ def _verify_commit_batch(
                     f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
                 )
             seen_vals[val_idx] = idx
-        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        bv.add(val.pub_key, all_sign_bytes[idx], commit_sig.signature)
         batch_sig_idxs.append(idx)
         if count_sig(commit_sig):
             tallied += val.voting_power

@@ -82,7 +82,7 @@ def testnet(tmp_path_factory):
             p.wait()
 
 
-def _wait_height(port, target, timeout=90):
+def _wait_height(port, target, timeout=240):
     cli_rpc = HTTPClient(f"http://127.0.0.1:{port}", timeout=3)
     deadline = time.time() + timeout
     last = -1
@@ -129,7 +129,7 @@ def test_killed_node_catches_up_after_restart(testnet):
     time.sleep(1.0)
     procs[2] = launch(2)
     target = h0 + 3
-    got = _wait_height(rpc_ports[2], target, timeout=120)
+    got = _wait_height(rpc_ports[2], target, timeout=300)
     assert got >= target
     # all three report the same block hash at a common height
     hashes = set()

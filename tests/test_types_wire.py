@@ -171,6 +171,50 @@ class TestRoundTrips:
         )
         assert Proposal.decode(p.encode()) == p
 
+    def test_vote_sign_bytes_all_matches_scalar_large_commit(self):
+        """The vectorized n >= 64 path must stay byte-identical to the
+        scalar splice across flags and varint-width extremes — it feeds
+        batch signature verification for every real-size commit."""
+        import random
+
+        from cometbft_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
+        from cometbft_tpu.types.cmttime import GO_ZERO_SECONDS, Time
+
+        rng = random.Random(11)
+        bid = BlockID(
+            hash=b"\x01" * 32,
+            part_set_header=PartSetHeader(total=3, hash=b"\x02" * 32),
+        )
+        sigs = []
+        for i in range(200):
+            ts = rng.choice(
+                [
+                    Time(1700000000 + rng.randrange(10**6), rng.randrange(10**9)),
+                    Time(0, 0),
+                    Time(GO_ZERO_SECONDS, 0),
+                    Time(-5, 7),
+                    Time(2**62, 999999999),
+                    Time(0, rng.randrange(1, 128)),
+                ]
+            )
+            flag = rng.choice([1, 2, 3])
+            if flag == 1:
+                sigs.append(CommitSig.absent())
+            else:
+                sigs.append(
+                    CommitSig(
+                        block_id_flag=flag,
+                        validator_address=bytes([i % 250]) * 20,
+                        timestamp=ts,
+                        signature=b"s" * 64,
+                    )
+                )
+        c = Commit(height=42, round=1, block_id=bid, signatures=sigs)
+        got = c.vote_sign_bytes_all("vec-chain")
+        assert len(got) == 200
+        for i in range(200):
+            assert got[i] == c.vote_sign_bytes("vec-chain", i), i
+
     def test_commit_sig_validate(self):
         CommitSig.absent().validate_basic()
         CommitSig(2, b"\xaa" * 20, Time(5, 6), b"\x01" * 64).validate_basic()
