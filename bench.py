@@ -400,7 +400,7 @@ def tpu_worker() -> None:
     plog(f"combined steady {stages['combined_ms']} ms")
 
     # ---- stage splits ----
-    verify = ek._compiled(dev_operands[0].shape[1])
+    verify = ek._compiled(*ek._bucket_key(dev_operands))
     stages["verify_ms"] = round(
         best_of(lambda: np.asarray(verify(*dev_operands))), 3
     )

@@ -10,20 +10,25 @@ checks its own cofactored equation
 in SPMD lockstep, so one device call yields the exact per-signature validity
 bitmap the callers need (types/validation.go:234-249) with no re-runs.
 
-Host side: SHA-512 challenge hashing of the variable-length messages
-(hashlib, C speed) and s-range checks — nothing else. The kernel takes the
-RAW 32/64-byte encodings as little-endian uint32 words (128 bytes per
-signature over the host->device link) and unpacks on device: point
-y-limbs/sign, k = digest mod L, and the signed-window digit recode
-(ops/unpack.py). Device side: decompression, the signed-4-bit-window
-double-scalar ladder (edwards.windowed_double_base_mult), and the identity
-test — one jit-compiled program per batch-size bucket.
+Host side: shape checks, the vectorized s-range check, and packing the
+challenge messages R || A || M into padded SHA-512 blocks — no crypto at
+all. The kernel takes the RAW 32-byte encodings as little-endian uint32
+words plus the padded challenge blocks, and runs the WHOLE verification on
+device: SHA-512 (sha512_kernel), k = digest mod L + signed-window recode +
+point decoding (ops/unpack.py), the signed-4-bit-window double-scalar
+ladder (edwards.windowed_double_base_mult), and the identity test — one
+jit-compiled program per (batch, block-count) bucket pair.
+
+CMTPU_HOST_HASH=1 opts back into hashlib challenge hashing on the host
+(the device then receives 64-byte digests instead of message blocks) for
+A/B probes.
 """
 
 from __future__ import annotations
 
 import functools
 import hashlib
+import os
 
 import numpy as np
 
@@ -32,13 +37,20 @@ import jax.numpy as jnp
 
 from cometbft_tpu.ops import edwards as ed
 from cometbft_tpu.ops import field25519 as fe
+from cometbft_tpu.ops import sha512_kernel as s5
 from cometbft_tpu.ops import unpack
 
 L = 2**252 + 27742317777372353535851937790883648493
 
+HOST_HASH = os.environ.get("CMTPU_HOST_HASH") == "1"
+
 # Fixed batch buckets: one compiled program per size, reused forever
 # (SURVEY.md §7 "pre-compiled fixed-shape programs + bucketed batch sizes").
 BUCKETS = (8, 32, 128, 512, 1024, 4096, 10240, 16384, 32768)
+# Challenge-message block counts bucket the other program axis: a canonical
+# vote challenge is 64 + ~120 bytes = 2 blocks; odd app messages fall into
+# the larger buckets.
+BLOCK_BUCKETS = (2, 4, 8, 32)
 
 
 def bucket_for(n: int) -> int:
@@ -48,14 +60,42 @@ def bucket_for(n: int) -> int:
     return int(2 ** np.ceil(np.log2(n)))
 
 
-def verify_core(a_words, r_words, s_words, k_words):
-    """Pure jittable core: raw little-endian words in (A, R as int32[8, N];
-    S as int32[8, N]; the SHA-512 challenge as int32[16, N]), bool[N] out.
-    Unpacking (limbs, mod L, digit recode) happens on device first; the A
-    and R decompressions then ride ONE width-2N pass (lane-stacked) — same
-    op count in half the program. Straight-line sections use compact_scope
-    (meaningful only under the opt-in planar lowering; a no-op for the
-    default stacked form)."""
+def block_bucket_for(b: int) -> int:
+    for bb in BLOCK_BUCKETS:
+        if b <= bb:
+            return bb
+    return int(2 ** np.ceil(np.log2(b)))
+
+
+def verify_core(a_words, r_words, s_words, msg_words, msg_nblocks):
+    """Pure jittable core: raw little-endian words in (A, R, S as
+    int32[8, N]) plus the padded SHA-512 challenge byte stream as native
+    uint32 words (uint32[N, B*32] — a FREE view of the host pack buffer —
+    and per-lane block counts int32[N]), bool[N] out. The whole verification
+    is on-device: block-layout transpose + byte swap, challenge hash,
+    k = digest mod L, digit recodes, point decoding, window ladder, identity
+    test. The A and R decompressions ride ONE width-2N pass (lane-stacked) —
+    same op count in half the program. Straight-line sections use
+    compact_scope (meaningful only under the opt-in planar lowering; a
+    no-op for the default stacked form)."""
+    n, bwords = msg_words.shape
+    bmax = bwords // 32
+    # [N, B*32] LE words -> [B, 2(hi/lo), 16, N] big-endian block words:
+    # layout shuffle + byte swap are the program's first (cheap, fused)
+    # ops instead of multi-MB host passes.
+    x = msg_words.astype(jnp.uint32).reshape(n, bmax, 16, 2)
+    blocks_be = s5.bswap32(jnp.transpose(x, (1, 3, 2, 0)))
+    k_words = s5.digest_to_le_words(s5.hash_blocks_core(blocks_be, msg_nblocks))
+    return _verify_from_words(a_words, r_words, s_words, k_words)
+
+
+def verify_core_hosthash(a_words, r_words, s_words, k_words):
+    """A/B variant (CMTPU_HOST_HASH=1): the 64-byte challenge digests come
+    pre-hashed from the host as int32[16, N] little-endian words."""
+    return _verify_from_words(a_words, r_words, s_words, k_words)
+
+
+def _verify_from_words(a_words, r_words, s_words, k_words):
     n = a_words.shape[1]
     y_a, sign_a = unpack.words_to_limbs255(a_words)
     y_r, sign_r = unpack.words_to_limbs255(r_words)
@@ -76,7 +116,12 @@ def verify_core(a_words, r_words, s_words, k_words):
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled(n: int):
+def _compiled(n: int, bmax: int = 0):
+    """One jitted program per (batch, block-count) bucket pair. The lru
+    wrapper (vs one global jax.jit) lets tests force a retrace after
+    flipping the fe lowering mode via cache_clear()."""
+    if HOST_HASH:
+        return jax.jit(verify_core_hosthash)
     return jax.jit(verify_core)
 
 
@@ -84,12 +129,13 @@ def warmup(buckets=(128, 1024, 10240), merkle_leaves=(1024, 65536)) -> None:
     """Precompile the verify program for the given batch buckets AND the
     fused Merkle leaves->root program ahead of first use (SURVEY §7 hard
     part 3: the <2 ms latency budget cannot absorb a per-call XLA compile).
-    Shape-only: feeds all-zero operands of each bucket's shape through the
-    jit so the compiled executable (and the persistent compile cache entry)
-    exists before the first real commit."""
+    Feeds vote-shaped (2-block) challenge messages so the compiled
+    executable (and the persistent compile cache entry) exists before the
+    first real commit."""
+    msg = b"\x00" * 120  # canonical-vote-sized: 64 + 120 -> 2 blocks
     for b in buckets:
-        operands, _ = pack_batch([b""] * b, [b""] * b, [b""] * b)
-        jax.block_until_ready(_compiled(operands[0].shape[1])(*operands))
+        operands, _ = pack_batch([b"\x00" * 32] * b, [msg] * b, [b"\x00" * 64] * b)
+        jax.block_until_ready(_compiled(*_bucket_key(operands))(*operands))
     from cometbft_tpu.ops import merkle_kernel as mk
 
     for n in merkle_leaves:
@@ -98,13 +144,16 @@ def warmup(buckets=(128, 1024, 10240), merkle_leaves=(1024, 65536)) -> None:
         jax.block_until_ready(mk._leaves_to_root_jit(1, n)(blocks, nblocks))
 
 
-def pack_batch(pubs, msgs, sigs):
-    """Host-side packing of one verification batch: per-signature SHA-512
-    challenges (hashlib, C speed), the vectorized s < L check, and raw-byte
-    -> word views — all limb/digit work happens on device (ops/unpack.py).
-    Returns device operands plus the host-decided validity mask (shape
-    errors, s >= L). Invalid entries are packed as zeros — lanes the device
-    evaluates but the mask vetoes."""
+def _bucket_key(operands) -> tuple[int, int]:
+    n = operands[0].shape[1]
+    bmax = operands[3].shape[1] // 32 if not HOST_HASH else 0
+    return n, bmax
+
+
+def _host_checks(pubs, sigs):
+    """Shared host-side packing: shape checks, byte matrices, vectorized
+    s < L. Returns (a_enc, r_enc, s_le, pubs_c, sigs_c, shape_ok,
+    s_in_range) with nb = bucket_for(n) rows."""
     n = len(pubs)
     nb = bucket_for(n)
     zero_pub, zero_sig = b"\x00" * 32, b"\x00" * 64
@@ -115,20 +164,16 @@ def pack_batch(pubs, msgs, sigs):
     a_enc = np.zeros((nb, 32), np.uint8)
     r_enc = np.zeros((nb, 32), np.uint8)
     s_le = np.zeros((nb, 32), np.uint8)
-    k_le = np.zeros((nb, 64), np.uint8)
+    s_in_range = np.zeros(n, bool)
     if n:
         a_enc[:n] = np.frombuffer(b"".join(pubs_c), np.uint8).reshape(n, 32)
         sig_arr = np.frombuffer(b"".join(sigs_c), np.uint8).reshape(n, 64)
         r_enc[:n] = sig_arr[:, :32]
         s_le[:n] = sig_arr[:, 32:]
-
-    host_ok = np.zeros(nb, bool)
-    if n:
         # s < L, vectorized: compare the four little-endian uint64 words
         # most-significant first.
         s_words = s_le[:n].view("<u8")  # [n, 4]
         l_words = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8")
-        s_in_range = np.zeros(n, bool)
         decided = np.zeros(n, bool)
         for w in (3, 2, 1, 0):
             lt = ~decided & (s_words[:, w] < l_words[w])
@@ -137,25 +182,86 @@ def pack_batch(pubs, msgs, sigs):
             decided |= lt | gt
         # s == L (all words equal) leaves decided False -> not in range.
         s_le[:n][~s_in_range] = 0
-    digest_rows = bytearray(64 * n)
-    sha512 = hashlib.sha512
-    for i in range(n):
-        if not shape_ok[i] or not s_in_range[i]:
-            continue
-        h = sha512(sigs_c[i][:32])
-        h.update(pubs_c[i])
-        h.update(msgs[i])
-        digest_rows[64 * i : 64 * (i + 1)] = h.digest()
-        host_ok[i] = True
-    if n:
-        k_le[:n] = np.frombuffer(bytes(digest_rows), np.uint8).reshape(n, 64)
+    return a_enc, r_enc, s_le, pubs_c, sigs_c, shape_ok, s_in_range
 
-    return (
+
+def pack_batch(pubs, msgs, sigs):
+    """Host-side packing of one verification batch — no crypto: shape
+    checks, the vectorized s < L check, raw-byte -> word views, and the
+    challenge messages R || A || M padded into SHA-512 blocks (the hashing
+    itself runs on device). Returns device operands plus the host-decided
+    validity mask (shape errors, s >= L). Invalid entries are packed as
+    zeros — lanes the device evaluates but the mask vetoes."""
+    n = len(pubs)
+    nb = bucket_for(n)
+    a_enc, r_enc, s_le, pubs_c, sigs_c, shape_ok, s_in_range = _host_checks(
+        pubs, sigs
+    )
+    host_ok = np.zeros(nb, bool)
+    if HOST_HASH:
+        k_le = np.zeros((nb, 64), np.uint8)
+        digest_rows = bytearray(64 * n)
+        sha512 = hashlib.sha512
+        for i in range(n):
+            if not shape_ok[i] or not s_in_range[i]:
+                continue
+            h = sha512(sigs_c[i][:32])
+            h.update(pubs_c[i])
+            h.update(msgs[i])
+            digest_rows[64 * i : 64 * (i + 1)] = h.digest()
+            host_ok[i] = True
+        if n:
+            k_le[:n] = np.frombuffer(bytes(digest_rows), np.uint8).reshape(n, 64)
+        operands = (
+            unpack.bytes_to_words(a_enc),
+            unpack.bytes_to_words(r_enc),
+            unpack.bytes_to_words(s_le),
+            unpack.bytes_to_words(k_le),
+        )
+        return operands, host_ok
+
+    host_ok[:n] = np.asarray(shape_ok) & s_in_range
+    # Challenge blocks R || A || M, padded, built vectorized: R and A bulk-
+    # copy from the already-built byte matrices; messages fill in one pass
+    # per DISTINCT length (a commit's sign-bytes have 1-3 layouts, so this
+    # is a couple of reshaped assignments, not an n-row python loop).
+    if n:
+        mlens = np.fromiter(
+            (len(msgs[i]) if shape_ok[i] else 0 for i in range(n)), np.int64, n
+        )
+    else:
+        mlens = np.zeros(0, np.int64)
+    tot = mlens + 64
+    nblocks = s5.blocks_for(tot)
+    bmax = block_bucket_for(int(nblocks.max()) if n else 1)
+    buf = np.zeros((nb, bmax * 128), np.uint8)
+    if n:
+        buf[:n, 0:32] = r_enc[:n]
+        buf[:n, 32:64] = a_enc[:n]
+        for ln in np.unique(mlens):
+            rows = np.nonzero(mlens == ln)[0]
+            if ln == 0:
+                continue
+            joined = b"".join(msgs[i] for i in rows if shape_ok[i])
+            want_rows = [i for i in rows if shape_ok[i]]
+            buf[want_rows, 64 : 64 + ln] = np.frombuffer(
+                joined, np.uint8
+            ).reshape(len(want_rows), ln)
+        s5.write_padding(buf[:n], tot, nblocks)
+    # Native-LE word view (free — no copy, no transpose; the device does
+    # the block-layout shuffle and byte swap itself).
+    pb = buf.view("<u4")
+    pnb = np.zeros(nb, np.int32)
+    pnb[:n] = nblocks
+    # padded lanes hash zero blocks (nblocks 0 -> IV digest): vetoed by mask
+    operands = (
         unpack.bytes_to_words(a_enc),
         unpack.bytes_to_words(r_enc),
         unpack.bytes_to_words(s_le),
-        unpack.bytes_to_words(k_le),
-    ), host_ok
+        pb,
+        pnb,
+    )
+    return operands, host_ok
 
 
 def batch_verify(pubs, msgs, sigs) -> tuple[bool, list]:
@@ -164,6 +270,6 @@ def batch_verify(pubs, msgs, sigs) -> tuple[bool, list]:
     if n == 0:
         return False, []
     operands, host_ok = pack_batch(pubs, msgs, sigs)
-    dev_ok = np.asarray(_compiled(operands[0].shape[1])(*operands))
+    dev_ok = np.asarray(_compiled(*_bucket_key(operands))(*operands))
     results = [bool(host_ok[i] and dev_ok[i]) for i in range(n)]
     return all(results), results

@@ -26,15 +26,29 @@ def make_mesh(devices=None, axis: str = "sig") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def _verify_specs(axis: str):
+    if ek.HOST_HASH:
+        raise NotImplementedError(
+            "CMTPU_HOST_HASH=1 is an A/B probe mode for the single-chip "
+            "kernel; the sharded path always hashes on device"
+        )
+    return (
+        P(None, axis),  # a_words [8, N]
+        P(None, axis),  # r_words [8, N]
+        P(None, axis),  # s_words [8, N]
+        P(axis, None),  # msg_words [N, B*32]
+        P(axis),  # msg_nblocks [N]
+    )
+
+
 def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     """jit-compiled batch verify with operands sharded over the batch dim
-    (raw word arrays are [8|16, N]: shard N — 128 bytes/sig crosses the
-    interconnect, unpacking runs shard-local on device). Returns ok bool[N]
-    (sharded)."""
-    shard_n = NamedSharding(mesh, P(None, axis))
+    (raw words + padded challenge blocks: everything after message
+    construction — SHA-512 included — runs shard-local on device). Returns
+    ok bool[N] (sharded)."""
     return jax.jit(
         ek.verify_core,
-        in_shardings=(shard_n,) * 4,
+        in_shardings=tuple(NamedSharding(mesh, s) for s in _verify_specs(axis)),
         out_shardings=NamedSharding(mesh, P(axis)),
     )
 
@@ -75,8 +89,8 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
     sharded signature batch AND reduces a sharded Merkle leaf forest, with a
     psum for the all-valid bit."""
 
-    def step(a_words, r_words, s_words, k_words, leaf_digests):
-        ok = ek.verify_core(a_words, r_words, s_words, k_words)
+    def step(a_words, r_words, s_words, msg_blocks, msg_nblocks, leaf_digests):
+        ok = ek.verify_core(a_words, r_words, s_words, msg_blocks, msg_nblocks)
 
         def reduce_shard(ok_shard, leaf_shard):
             local_ok = jnp.all(ok_shard).astype(jnp.int32)
@@ -96,8 +110,13 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
         all_valid = jnp.sum(total_ok) == n_dev * n_dev  # psum'd per shard
         return ok, all_valid, root_cols[:, :1]
 
-    shard_n = NamedSharding(mesh, P(None, axis))
-    return jax.jit(step, in_shardings=(shard_n,) * 5)
+    return jax.jit(
+        step,
+        in_shardings=tuple(
+            NamedSharding(mesh, s)
+            for s in (*_verify_specs(axis), P(None, axis))
+        ),
+    )
 
 
 def make_example_batch(n: int):
