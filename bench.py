@@ -371,9 +371,11 @@ def tpu_worker() -> None:
     # ---- combined single-dispatch program (headline) ----
     import jax.numpy as jnp
 
+    verify_fn = ek.verify_core_hosthash if len(operands) == 4 else ek.verify_core
+
     @jax.jit
     def combined(ops, blk, nblk):
-        ok = ek.verify_core(*ops)
+        ok = verify_fn(*ops)
         root = mk.leaves_to_root_core(blk, nblk)
         return ok, root
 

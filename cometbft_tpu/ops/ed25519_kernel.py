@@ -117,10 +117,11 @@ def _verify_from_words(a_words, r_words, s_words, k_words):
 
 @functools.lru_cache(maxsize=None)
 def _compiled(n: int, bmax: int = 0):
-    """One jitted program per (batch, block-count) bucket pair. The lru
-    wrapper (vs one global jax.jit) lets tests force a retrace after
-    flipping the fe lowering mode via cache_clear()."""
-    if HOST_HASH:
+    """One jitted program per (batch, block-count) bucket pair; bmax 0 is
+    the host-hash program (pre-hashed digests in). The lru wrapper (vs one
+    global jax.jit) lets tests force a retrace after flipping the fe
+    lowering mode via cache_clear()."""
+    if bmax == 0:
         return jax.jit(verify_core_hosthash)
     return jax.jit(verify_core)
 
@@ -145,8 +146,11 @@ def warmup(buckets=(128, 1024, 10240), merkle_leaves=(1024, 65536)) -> None:
 
 
 def _bucket_key(operands) -> tuple[int, int]:
+    """(batch, block) bucket pair; bmax 0 selects the host-hash program
+    (4 operands: either CMTPU_HOST_HASH=1, or the oversized-message
+    fallback in pack_batch)."""
     n = operands[0].shape[1]
-    bmax = operands[3].shape[1] // 32 if not HOST_HASH else 0
+    bmax = operands[3].shape[1] // 32 if len(operands) == 5 else 0
     return n, bmax
 
 
@@ -198,7 +202,18 @@ def pack_batch(pubs, msgs, sigs):
         pubs, sigs
     )
     host_ok = np.zeros(nb, bool)
-    if HOST_HASH:
+    if n:
+        mlens = np.fromiter(
+            (len(msgs[i]) if shape_ok[i] else 0 for i in range(n)), np.int64, n
+        )
+    else:
+        mlens = np.zeros(0, np.int64)
+    # Oversized messages (past the largest block bucket) fall back to host
+    # hashing: the hosthash program's shapes are independent of message
+    # length, so an adversary feeding growing messages cannot force a fresh
+    # XLA compile per size.
+    oversized = n > 0 and int(mlens.max()) + 64 > BLOCK_BUCKETS[-1] * 128 - 17
+    if HOST_HASH or oversized:
         k_le = np.zeros((nb, 64), np.uint8)
         digest_rows = bytearray(64 * n)
         sha512 = hashlib.sha512
@@ -225,12 +240,6 @@ def pack_batch(pubs, msgs, sigs):
     # copy from the already-built byte matrices; messages fill in one pass
     # per DISTINCT length (a commit's sign-bytes have 1-3 layouts, so this
     # is a couple of reshaped assignments, not an n-row python loop).
-    if n:
-        mlens = np.fromiter(
-            (len(msgs[i]) if shape_ok[i] else 0 for i in range(n)), np.int64, n
-        )
-    else:
-        mlens = np.zeros(0, np.int64)
     tot = mlens + 64
     nblocks = s5.blocks_for(tot)
     bmax = block_bucket_for(int(nblocks.max()) if n else 1)
@@ -239,14 +248,13 @@ def pack_batch(pubs, msgs, sigs):
         buf[:n, 0:32] = r_enc[:n]
         buf[:n, 32:64] = a_enc[:n]
         for ln in np.unique(mlens):
-            rows = np.nonzero(mlens == ln)[0]
             if ln == 0:
-                continue
-            joined = b"".join(msgs[i] for i in rows if shape_ok[i])
-            want_rows = [i for i in rows if shape_ok[i]]
-            buf[want_rows, 64 : 64 + ln] = np.frombuffer(
-                joined, np.uint8
-            ).reshape(len(want_rows), ln)
+                continue  # shape-invalid rows were forced to length 0
+            rows = np.nonzero(mlens == ln)[0]
+            joined = b"".join(msgs[i] for i in rows)
+            buf[rows, 64 : 64 + ln] = np.frombuffer(joined, np.uint8).reshape(
+                len(rows), ln
+            )
         s5.write_padding(buf[:n], tot, nblocks)
     # Native-LE word view (free — no copy, no transpose; the device does
     # the block-layout shuffle and byte swap itself).

@@ -25,6 +25,96 @@ from cometbft_tpu.types.priv_validator import MockPV
 CHAIN = "byz-chain"
 
 
+def _make_net(pvs, gen):
+    def make(pv):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = ""
+        cfg.consensus.timeout_commit = 0.15
+        cfg.consensus.skip_timeout_commit = False
+        return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
+
+    return [make(pv) for pv in pvs]
+
+
+def test_invalid_votes_do_not_wedge_consensus():
+    """consensus/invalid_test.go shape: a peer floods votes with garbage
+    signatures and votes from a key outside the validator set; honest nodes
+    must reject them (no crash, no evidence for honest validators) and the
+    chain keeps committing."""
+    pvs = [MockPV() for _ in range(4)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+    nodes = _make_net(pvs, gen)
+    outsider = MockPV()  # not in the validator set
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < 2:
+            time.sleep(0.05)
+        assert cs0.rs.height >= 2, "net never started committing"
+
+        src = nodes[3]
+
+        def flood_invalid():
+            rs = src.consensus_state.rs
+            h, r = rs.height, rs.round
+            now = cmttime.now()
+            bid = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xcc" * 32))
+            # (a) garbage signature under a real validator identity
+            bad_sig = Vote(
+                type=PREVOTE_TYPE, height=h, round=r, block_id=bid,
+                timestamp=now, validator_address=pvs[2].address(),
+                validator_index=2,
+            ).with_signature(b"\x01" * 64)
+            # (b) correctly signed vote from a NON-validator
+            out_vote = Vote(
+                type=PREVOTE_TYPE, height=h, round=r, block_id=bid,
+                timestamp=now, validator_address=outsider.address(),
+                validator_index=1,
+            )
+            out_vote = outsider.sign_vote(CHAIN, out_vote)
+            for v in (bad_sig, out_vote):
+                src.consensus_reactor._broadcast_own_message(cmsg.VoteMessage(v))
+
+        start_h = cs0.rs.height
+        deadline = time.time() + 90
+        while time.time() < deadline and cs0.rs.height < start_h + 4:
+            flood_invalid()
+            time.sleep(0.2)
+        assert cs0.rs.height >= start_h + 4, "chain wedged under invalid votes"
+
+        # No evidence may be fabricated against the innocent validator 2.
+        for n in nodes[:3]:
+            for h in range(1, n.block_store.height() + 1):
+                block = n.block_store.load_block(h)
+                if block is None:
+                    continue
+                for ev in block.evidence:
+                    assert not (
+                        isinstance(ev, DuplicateVoteEvidence)
+                        and ev.vote_a.validator_address == pvs[2].address()
+                    ), "garbage-signature vote produced evidence"
+    finally:
+        for n in nodes:
+            n.stop()
+
+
 def test_prevote_equivocation_lands_in_committed_block():
     pvs = [MockPV() for _ in range(4)]
     gen = GenesisDoc(

@@ -69,6 +69,60 @@ def net(tmp_path):
         node.stop()
 
 
+def test_rpc_server_survives_malformed_input(net):
+    """test/fuzz rpc-server analog: adversarial HTTP bodies and URLs must
+    yield clean JSON-RPC errors (or HTTP errors), never kill the server —
+    proven by a normal status call succeeding after every volley."""
+    import http.client
+    import random
+
+    port = net[0].rpc_port
+    rng = random.Random(21)
+
+    def post_raw(body: bytes, ctype="application/json"):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("POST", "/", body=body, headers={"Content-Type": ctype})
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        finally:
+            conn.close()
+
+    bodies = [
+        b"",
+        b"{",
+        b"[]",
+        b"null",
+        b'{"jsonrpc": "2.0"}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": 42}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "no_such_method"}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "block", "params": {"height": "not-a-number"}}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "block", "params": [1, 2, 3, 4]}',
+        b'{"jsonrpc": "2.0", "id": {}, "method": "status", "params": "bogus"}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "abci_query", "params": {"data": "zz-not-hex"}}',
+        b"\xff\xfe garbage \x00\x01" * 50,
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": "tx_search",
+                    "query": "malformed ==== query"}).encode(),
+    ]
+    bodies += [bytes(rng.getrandbits(8) for _ in range(rng.randrange(1, 400))) for _ in range(30)]
+    for body in bodies:
+        post_raw(body)  # any status is fine; no hang, no crash
+
+    # GET-URI handler with hostile query strings
+    for uri in ("/block?height=-1", "/block?height=abc", "/no_such",
+                "/abci_query?data=0xzz", "/tx?hash=nothex&prove=yes",
+                "/subscribe?query=" + "%27" * 50):
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}{uri}", timeout=10).read()
+        except Exception:
+            pass
+
+    # the server is still fully functional
+    st = _rpc(port, "status")
+    assert "sync_info" in st
+
+
 def test_rpc_surface(net):
     node0 = net[0]
     port = node0.rpc_port

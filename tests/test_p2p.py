@@ -45,6 +45,73 @@ def test_secret_connection_roundtrip():
     assert got == big
 
 
+def test_secret_connection_rejects_tampered_ciphertext():
+    """AEAD integrity: flipping any ciphertext bit on the wire must surface
+    as a clean connection error on the reader — never plaintext corruption,
+    never a hang (test/fuzz p2p/secretconnection analog)."""
+    import random
+
+    rng = random.Random(9)
+    for trial in range(6):
+        a, mitm_a = socket.socketpair()
+        mitm_b, b = socket.socketpair()
+        k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+        stop = threading.Event()
+
+        def relay(src, dst, corrupt_after):
+            """Forward bytes, flipping one bit in one byte past the
+            handshake (the handshake itself must stay intact)."""
+            forwarded = 0
+            corrupted = False
+            try:
+                while not stop.is_set():
+                    chunk = bytearray(src.recv(4096))
+                    if not chunk:
+                        break
+                    if not corrupted and forwarded + len(chunk) > corrupt_after:
+                        i = rng.randrange(len(chunk))
+                        chunk[i] ^= 1 << rng.randrange(8)
+                        corrupted = True
+                    forwarded += len(chunk)
+                    dst.sendall(bytes(chunk))
+            except OSError:
+                pass
+
+        # handshake is ~100s of bytes each way; corrupt only after 700.
+        threading.Thread(target=relay, args=(mitm_a, mitm_b, 700), daemon=True).start()
+        threading.Thread(target=relay, args=(mitm_b, mitm_a, 10**9), daemon=True).start()
+
+        result = {}
+
+        def server():
+            try:
+                sc = SecretConnection(b, k2)
+                result["got"] = sc.read_exact(4096)
+            except Exception as e:
+                result["err"] = e
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        try:
+            sc1 = SecretConnection(a, k1)
+            payload = bytes(rng.getrandbits(8) for _ in range(4096))
+            sc1.write(payload)
+        except Exception:
+            pass  # tamper may already break the sender side
+        t.join(timeout=10)
+        stop.set()
+        for s in (a, b, mitm_a, mitm_b):
+            try:
+                s.close()
+            except OSError:
+                pass
+        assert not t.is_alive(), "reader hung on tampered ciphertext"
+        if "got" in result:
+            assert result["got"] == payload, "tampered frame yielded corrupted plaintext"
+        else:
+            assert "err" in result  # clean rejection
+
+
 class EchoReactor(Reactor):
     def __init__(self, chan_id):
         super().__init__("echo")
