@@ -118,6 +118,24 @@ def test_processes_commit_blocks_and_index_tx(testnet):
     assert base64.b64decode(q["response"]["value"]) == b"proc"
 
 
+def test_paused_node_resumes_and_catches_up(testnet):
+    """The reference e2e runner's 'pause' perturbation
+    (test/e2e/pkg/manifest.go perturbations): SIGSTOP one validator — the
+    other two hold exactly 2/3, so the chain stalls — then SIGCONT; the
+    frozen process must pick up where it left off (peers kept its
+    connections half-open) and the chain resumes without a restart."""
+    root, rpc_ports, procs, _ = testnet
+    h0 = _wait_height(rpc_ports[0], 3)
+    procs[1].send_signal(signal.SIGSTOP)
+    try:
+        time.sleep(3.0)
+    finally:
+        procs[1].send_signal(signal.SIGCONT)
+    target = h0 + 3
+    got = _wait_height(rpc_ports[1], target, timeout=300)
+    assert got >= target, f"paused node stuck at {got}"
+
+
 def test_killed_node_catches_up_after_restart(testnet):
     root, rpc_ports, procs, launch = testnet
     h0 = _wait_height(rpc_ports[0], 4)
