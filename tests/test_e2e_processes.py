@@ -129,11 +129,22 @@ def test_paused_node_resumes_and_catches_up(testnet):
     procs[1].send_signal(signal.SIGSTOP)
     try:
         time.sleep(3.0)
+        # The pause must actually bite: a SIGSTOPped node serves no RPC, so
+        # its height query fails — if it answered, the perturbation was a
+        # no-op and this test would be vacuous.
+        import urllib.request
+
+        paused = False
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rpc_ports[1]}/status", timeout=2
+            ).read()
+        except Exception:
+            paused = True
+        assert paused, "node still answered RPC while SIGSTOPped"
     finally:
         procs[1].send_signal(signal.SIGCONT)
-    target = h0 + 3
-    got = _wait_height(rpc_ports[1], target, timeout=300)
-    assert got >= target, f"paused node stuck at {got}"
+    _wait_height(rpc_ports[1], h0 + 3, timeout=300)
 
 
 def test_killed_node_catches_up_after_restart(testnet):
