@@ -101,6 +101,21 @@ class BlockPool:
                 second.block if second else None,
             )
 
+    def peek_window(self, max_k: int) -> list:
+        """Consecutive fetched blocks starting at the sync height (up to
+        max_k) — the prefetch window the reactor batch-verifies in one
+        device dispatch."""
+        with self._mtx:
+            out = []
+            h = self.height
+            while len(out) < max_k:
+                req = self._requesters.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append(req.block)
+                h += 1
+            return out
+
     def pop_request(self) -> None:
         """Advance after the first block validated + applied."""
         with self._mtx:

@@ -76,6 +76,7 @@ class BlocksyncReactor(Reactor):
         self.pool = BlockPool(state.last_block_height + 1, self._send_request)
         self._running = False
         self.synced = False
+        self._prefetched_to = 0  # height up to which the window was batched
 
     def get_channels(self):
         return [
@@ -170,12 +171,74 @@ class BlocksyncReactor(Reactor):
                 return
             time.sleep(0.01)
 
+    # Prefetch window: how many consecutive fetched blocks to batch-verify
+    # in ONE device dispatch. 32 blocks x 1k validators fills the 32768
+    # bucket; the verified-triple cache then makes both the trySync
+    # VerifyCommitLight AND ApplyBlock's full LastCommit check cache hits.
+    PREFETCH_WINDOW = 32
+
+    def _prefetch_verify_window(self) -> None:
+        """TPU-first fast sync: while validator sets are unchanged
+        (header.validators_hash pins the exact set that signed each
+        commit), the signatures of MANY consecutive blocks' commits are
+        independent — verify them all in one batched device call and let
+        the per-commit protocol checks hit the verified-triple cache.
+        Failures are simply not cached; the per-block path then attributes
+        the bad block and punishes the peer as before."""
+        from cometbft_tpu.crypto import ed25519
+
+        if self.pool.height < self._prefetched_to:
+            return
+        window = self.pool.peek_window(self.PREFETCH_WINDOW)
+        if len(window) < 3:
+            return
+        vals = self.state.validators
+        # Only ed25519 carries the verified-triple cache; for other key
+        # types a prefetch would be pure extra work (three verifications
+        # per commit instead of two).
+        if not all(
+            isinstance(v.pub_key, ed25519.PubKey) for v in vals.validators
+        ):
+            self._prefetched_to = self.pool.height + self.PREFETCH_WINDOW
+            return
+        # A pure optimization must never take down the sync thread: blocks
+        # here are unvalidated peer input (oversized signatures etc. make
+        # bv.add raise), and backend hiccups surface from bv.verify — the
+        # per-block path re-verifies, attributes, and punishes as before.
+        try:
+            bv = ed25519.BatchVerifier()
+            vh = vals.hash()
+            chain_id = self.state.chain_id
+            covered = 0
+            for j in range(len(window) - 1):
+                blk, nxt = window[j], window[j + 1]
+                commit = nxt.last_commit
+                if (
+                    blk.header.validators_hash != vh
+                    or commit is None
+                    or commit.height != blk.header.height
+                    or len(commit.signatures) != len(vals.validators)
+                ):
+                    break
+                sbs = commit.vote_sign_bytes_all(chain_id)
+                for idx, cs in enumerate(commit.signatures):
+                    if cs.is_absent():
+                        continue
+                    bv.add(vals.validators[idx].pub_key, sbs[idx], cs.signature)
+                covered += 1
+            self._prefetched_to = self.pool.height + max(covered, 1)
+            if covered >= 2 and len(bv):
+                bv.verify()  # populates the cache; bad sigs fall to per-block
+        except Exception:
+            self._prefetched_to = self.pool.height + 1
+
     def _try_sync_one(self) -> bool:
         """reactor.go:340-400 trySync: verify `first` with `second.LastCommit`
         (VerifyCommitLight — batched on device), then apply."""
         first, second = self.pool.peek_two_blocks()
         if first is None or second is None:
             return False
+        self._prefetch_verify_window()
         first_parts = first.make_part_set()
         first_id = BlockID(first.hash(), first_parts.header())
         try:

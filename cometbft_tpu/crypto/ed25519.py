@@ -16,6 +16,7 @@ TPU batch verifier instead (cometbft_tpu/ops/ed25519_kernel.py).
 from __future__ import annotations
 
 import hashlib
+import threading
 
 from cryptography.exceptions import InvalidSignature
 from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -125,6 +126,32 @@ def _from_seed(seed: bytes) -> PrivKey:
     return PrivKey(seed + pub)
 
 
+# Verified-triple cache: the device analog of the reference's caching
+# verifier seam (ed25519.go:31-56 caches EXPANDED KEYS; here whole verified
+# (pub, sig, msg) triples are cached, because fast sync verifies every
+# commit twice — VerifyCommitLight in blocksync's trySync, then the full
+# VerifyCommit in ApplyBlock's validation — and the blocksync reactor
+# pre-verifies whole windows of blocks in one device dispatch). Only VALID
+# results are cached (deterministic; an attacker replaying a valid triple
+# gets the same answer crypto would give), keyed by the full concatenated
+# triple. Bounded: oldest quarter evicted on overflow.
+_VERIFIED_MAX = 131072
+_verified: dict[bytes, None] = {}
+_verified_lock = threading.Lock()
+
+
+def _verified_put(key: bytes) -> None:
+    # Writers race from multiple threads (blocksync pool routine, consensus,
+    # light client): eviction takes the lock, and pop() tolerates a key a
+    # concurrent evictor already removed.
+    if len(_verified) >= _VERIFIED_MAX:
+        with _verified_lock:
+            if len(_verified) >= _VERIFIED_MAX:
+                for k in list(_verified)[: _VERIFIED_MAX // 4]:
+                    _verified.pop(k, None)
+    _verified[key] = None
+
+
 class BatchVerifier(crypto.BatchVerifier):
     """Ed25519 batch verification (ed25519.go:196-228).
 
@@ -161,4 +188,13 @@ class BatchVerifier(crypto.BatchVerifier):
 
         if not self._pubs:
             return False, []
-        return get_backend().batch_verify(self._pubs, self._msgs, self._sigs)
+        keys = [
+            p + s + m for p, s, m in zip(self._pubs, self._sigs, self._msgs)
+        ]
+        if all(k in _verified for k in keys):
+            return True, [True] * len(keys)
+        ok, bits = get_backend().batch_verify(self._pubs, self._msgs, self._sigs)
+        for k, valid in zip(keys, bits):
+            if valid:
+                _verified_put(k)
+        return ok, bits
