@@ -440,11 +440,23 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
         be.set_backend(None)
         from cometbft_tpu.types import validation
 
+        from cometbft_tpu.crypto import ed25519 as _ed
+
         vals, commits = _commit_fixture(N_SIGS, heights=1)
         bid, commit = commits[0]
         plog(f"commit fixture built ({N_SIGS} validators)")
         validation.verify_commit_light("bench-chain", vals, bid, 1, commit)  # warm
-        stages["commit_light_e2e_ms"] = round(
+
+        def _cold_verify():
+            # The verified-triple cache would otherwise make every rep after
+            # the first a cache hit; the e2e number must measure real crypto.
+            _ed._verified.clear()
+            validation.verify_commit_light("bench-chain", vals, bid, 1, commit)
+
+        stages["commit_light_e2e_ms"] = round(best_of(_cold_verify), 2)
+        # The cached path IS production behavior (blocksync re-verifies the
+        # same commits in ApplyBlock) — report it separately, labeled.
+        stages["commit_light_cached_ms"] = round(
             best_of(
                 lambda: validation.verify_commit_light(
                     "bench-chain", vals, bid, 1, commit
@@ -452,7 +464,10 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             ),
             2,
         )
-        plog(f"VerifyCommitLight e2e {stages['commit_light_e2e_ms']} ms")
+        plog(
+            f"VerifyCommitLight e2e {stages['commit_light_e2e_ms']} ms "
+            f"(cached {stages['commit_light_cached_ms']} ms)"
+        )
 
     # ---- blocksync replay: 100 blocks x 1,024-validator commits ----
     if budget_left():
