@@ -17,7 +17,10 @@ the rest of BASELINE.md's configs so regressions are attributable:
                           (compile or persistent-cache hit; VERDICT r3 #2)
   commit_light_e2e_ms     the SHIPPED path: types/validation VerifyCommitLight
                           over a real 10,240-validator Commit -> crypto.batch
-                          -> backend -> kernel (includes all marshalling)
+                          -> backend -> kernel (includes all marshalling);
+                          COLD — the verified-triple cache is cleared per rep
+  commit_light_cached_ms  same call with the cache warm (production behavior
+                          for blocksync's double verification)
   blocksync_replay_ms_per_block   100-block fast-sync replay, 1,024-validator
                           commits (blocksync/reactor.go:355 trySync shape)
   light_bisection_ms      light-client skipping verification to height 500

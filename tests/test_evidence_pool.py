@@ -1,0 +1,120 @@
+"""Evidence pool lifecycle (reference: evidence/pool.go + pool_test.go):
+pending -> proposed -> committed, and age-based expiry pruning — the one
+path the e2e byzantine/light-attack tests never exercise."""
+
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.evidence.pool import EvidencePool
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.types import GenesisDoc, GenesisValidator, Time, Vote
+from cometbft_tpu.types.block import PRECOMMIT_TYPE, BlockID
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from tests.test_blocksync import CHAIN_ID, _populated_chain
+
+
+@pytest.fixture
+def rig():
+    pvs = [MockPV() for _ in range(3)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    state, block_store, executor = _populated_chain(pvs, gen, 6)
+    pool = EvidencePool(MemDB(), executor.state_store, block_store)
+    return state, pool, pvs
+
+
+def _dup_evidence(pool, pv, height=2):
+    vals = pool.state_store.load_validators(height)
+    idx = next(
+        i for i, v in enumerate(vals.validators) if v.address == pv.address()
+    )
+    votes = []
+    for mark in (b"\xaa", b"\xbb"):
+        v = Vote(
+            type=PRECOMMIT_TYPE,
+            height=height,
+            round=0,
+            block_id=BlockID(mark * 32, PartSetHeader(1, mark * 32)),
+            timestamp=pool.block_store.load_block_meta(height).header.time,
+            validator_address=pv.address(),
+            validator_index=idx,
+        )
+        votes.append(pv.sign_vote(CHAIN_ID, v))
+    return DuplicateVoteEvidence.new(
+        votes[0], votes[1],
+        pool.block_store.load_block_meta(height).header.time, vals,
+    )
+
+
+def test_add_pending_commit_lifecycle(rig):
+    state, pool, pvs = rig
+    ev = _dup_evidence(pool, pvs[0])
+    pool.add_evidence(ev)
+    pending, size = pool.pending_evidence(-1)
+    assert [e.hash() for e in pending] == [ev.hash()] and size > 0
+    # re-add is a dedup no-op
+    pool.add_evidence(ev)
+    assert len(pool.pending_evidence(-1)[0]) == 1
+    # committed: removed from pending, re-check rejects it
+    new_state = replace(
+        state,
+        last_block_height=state.last_block_height + 1,
+        last_block_time=state.last_block_time.add_nanos(10**9),
+    )
+    pool.update(new_state, [ev])
+    assert pool.pending_evidence(-1)[0] == []
+    with pytest.raises(ValueError, match="already committed"):
+        pool.check_evidence([ev])
+
+
+def test_expired_evidence_is_pruned(rig):
+    state, pool, pvs = rig
+    ev = _dup_evidence(pool, pvs[1])
+    pool.add_evidence(ev)
+    assert len(pool.pending_evidence(-1)[0]) == 1
+    params = state.consensus_params
+    tight = replace(
+        params,
+        evidence=replace(params.evidence, max_age_num_blocks=2,
+                         max_age_duration_ns=10**9),
+    )
+    # age 3 blocks AND 2s: both bounds exceeded -> pruned (the reference
+    # requires BOTH, pool.go:133)
+    expired_state = replace(
+        state,
+        last_block_height=state.last_block_height + 3,
+        last_block_time=ev.time().add_nanos(2 * 10**9),
+        consensus_params=tight,
+    )
+    pool.update(expired_state, [])
+    assert pool.pending_evidence(-1)[0] == []
+
+
+def test_not_expired_until_both_bounds_pass(rig):
+    state, pool, pvs = rig
+    ev = _dup_evidence(pool, pvs[2])
+    pool.add_evidence(ev)
+    params = state.consensus_params
+    tight = replace(
+        params,
+        evidence=replace(params.evidence, max_age_num_blocks=2,
+                         max_age_duration_ns=10**12),
+    )
+    # old by blocks but NOT by duration -> must stay pending
+    young_state = replace(
+        state,
+        last_block_height=state.last_block_height + 3,
+        last_block_time=ev.time().add_nanos(10**9),
+        consensus_params=tight,
+    )
+    pool.update(young_state, [])
+    assert len(pool.pending_evidence(-1)[0]) == 1

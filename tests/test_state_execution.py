@@ -109,6 +109,59 @@ def test_apply_five_blocks(rig):
     assert vals_h3.size() == 4
 
 
+def test_consensus_param_updates_flow_through_endblock(rig):
+    """EndBlock's consensus_param_updates must land in state (applied next
+    height, state/execution.go updateState) and change the header's
+    ConsensusHash — the app-driven on-chain parameter-change path."""
+    state, executor, mempool, block_store, state_store, pv_by_addr, app = rig
+    from cometbft_tpu.types.params import BlockParams, ConsensusParams
+
+    old_max = state.consensus_params.block.max_bytes
+    new_max = old_max // 2
+
+    orig_end_block = app.end_block
+
+    def end_block_with_update(req):
+        resp = orig_end_block(req)
+        if req.height == 1:
+            resp.consensus_param_updates = ConsensusParams(
+                block=BlockParams(max_bytes=new_max, max_gas=-1)
+            )
+        return resp
+
+    app.end_block = end_block_with_update
+
+    last_commit = Commit(height=0, round=0)
+    hashes = []
+    for h in (1, 2):
+        height = state.last_block_height + 1
+        proposer = state.validators.get_proposer()
+        block = executor.create_proposal_block(
+            height, state, last_commit if height > 1 else Commit(height=0, round=0),
+            proposer.address,
+        )
+        if height == 1:
+            block.last_commit = Commit(height=0, round=0)
+        part_set = block.make_part_set()
+        block_id = BlockID(block.hash(), part_set.header())
+        seen = _make_commit(state, block, block_id, pv_by_addr, height)
+        block_store.save_block(block, part_set, seen)
+        hashes.append(block.header.consensus_hash)
+        state, _ = executor.apply_block(state, block_id, block)
+        last_commit = seen
+    assert state.consensus_params.block.max_bytes == new_max
+    # Updates returned at height 1 take effect from height 2's header on
+    # (state/execution.go updateState): block 1 carries the genesis hash,
+    # block 2 already the new one, and later proposals keep it.
+    assert hashes[1] != hashes[0]
+    assert hashes[1] == state.consensus_params.hash()
+    height = state.last_block_height + 1
+    block3 = executor.create_proposal_block(
+        height, state, last_commit, state.validators.get_proposer().address
+    )
+    assert block3.header.consensus_hash == state.consensus_params.hash()
+
+
 def test_invalid_block_rejected(rig):
     state, executor, mempool, block_store, state_store, pv_by_addr, app = rig
     proposer = state.validators.get_proposer()
