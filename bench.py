@@ -356,6 +356,10 @@ def tpu_worker() -> None:
     from cometbft_tpu.ops import sha256_kernel as sha
 
     stages = {}
+    # Attribution: which kernel variant produced this line.
+    stages["fe_mode"] = os.environ.get("CMTPU_FE_MODE", "auto")
+    if os.environ.get("CMTPU_HOST_HASH") == "1":
+        stages["host_hash"] = True
 
     # ---- host packing ----
     pvs, pubs, msgs, sigs = _signed_batch(N_SIGS)

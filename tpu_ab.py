@@ -67,7 +67,31 @@ def worker(mode: str) -> None:
     )
 
 
+def best_mode(log_path: str = "tpu_ab.log") -> str:
+    """Fastest mode with a steady_ms line in the A/B log ('' if none)."""
+    best, best_ms = "", float("inf")
+    try:
+        with open(log_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                ms = rec.get("steady_ms")
+                if ms is not None and ms < best_ms:
+                    best, best_ms = rec.get("mode", ""), ms
+    except OSError:
+        pass
+    return best
+
+
 def main() -> int:
+    if "--best" in sys.argv:
+        print(best_mode())
+        return 0
     for mode, tmo in MODES:
         env = {**os.environ, "CMTPU_FE_MODE": mode}
         try:
