@@ -356,8 +356,11 @@ def tpu_worker() -> None:
     from cometbft_tpu.ops import sha256_kernel as sha
 
     stages = {}
-    # Attribution: which kernel variant produced this line.
-    stages["fe_mode"] = os.environ.get("CMTPU_FE_MODE", "auto")
+    # Attribution: which kernel variant produced this line (the RESOLVED
+    # lowering — 'auto' would label different variants identically).
+    from cometbft_tpu.ops import field25519 as _fe
+
+    stages["fe_mode"] = _fe._mode()
     if os.environ.get("CMTPU_HOST_HASH") == "1":
         stages["host_hash"] = True
 

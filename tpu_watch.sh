@@ -38,7 +38,16 @@ for i in $(seq 1 1400); do
   if port_open; then
     log "relay OPEN (iter $i); running bench"
     echo RUNNING > .tpu_status
-    timeout 1500 python -u bench.py > tpu_bench.out 2> tpu_bench.err
+    # A previous A/B round may have picked a winning lowering; stick to it
+    # for every later bench run (otherwise the next loop iteration would
+    # clobber the better alt-mode result with the default mode's).
+    FE_MODE=$(cat .tpu_fe_mode 2>/dev/null || true)
+    if [ -n "$FE_MODE" ]; then
+      CMTPU_FE_MODE="$FE_MODE" timeout 1500 python -u bench.py \
+        > tpu_bench.out 2> tpu_bench.err
+    else
+      timeout 1500 python -u bench.py > tpu_bench.out 2> tpu_bench.err
+    fi
     rc=$?
     log "bench rc=$rc"
     tail -25 tpu_bench.err >> tpu_watch.log
@@ -58,23 +67,34 @@ for i in $(seq 1 1400); do
       # planar timing out forever must not retrigger the probe.
       if [ ! -f tpu_ab.log ] || [ "$(grep -c steady_ms tpu_ab.log)" -lt 2 ]; then
         log "running fe-lowering A/B probe"
-        timeout 1800 python -u tpu_ab.py >> tpu_ab.log 2>> tpu_watch.log
+        # Fresh log per probe: --best must reflect THIS kernel build, not
+        # steady_ms lines from superseded code in an append-only history.
+        [ -f tpu_ab.log ] && mv tpu_ab.log tpu_ab.log.1
+        timeout 1800 python -u tpu_ab.py > tpu_ab.log 2>> tpu_watch.log
         log "A/B probe done"
         # If a non-default lowering won the A/B, re-bench with it and keep
         # whichever JSON line reports the better (smaller) headline value.
-        BEST=$(python tpu_ab.py --best 2>/dev/null)
+        # Helper pythons are CPU-only file parsing: strip the relay env
+        # (sitecustomize would dial the wedge-prone tunnel) and bound them.
+        BEST=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+               timeout 60 python tpu_ab.py --best 2>/dev/null)
         if [ -n "$BEST" ] && [ "$BEST" != "stacked" ]; then
           log "A/B winner is $BEST; re-running bench with it"
+          echo "$BEST" > .tpu_fe_mode
           CMTPU_FE_MODE="$BEST" timeout 1500 python -u bench.py \
             > tpu_bench_alt.out 2>> tpu_watch.log
-          python - <<'PYEOF' >> tpu_watch.log 2>&1
+          env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu timeout 60 \
+            python - <<'PYEOF' >> tpu_watch.log 2>&1
 import json
 def val(path):
     try:
         for line in open(path):
             if line.startswith("{"):
-                rec = json.loads(line)
-                if "cpu" not in str(rec.get("platform", "")):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "cpu" not in str(rec.get("platform", "")) and "value" in rec:
                     return rec
     except OSError:
         pass
