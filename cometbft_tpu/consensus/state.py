@@ -276,29 +276,80 @@ class ConsensusState:
     def _receive_routine(self) -> None:
         while self._running:
             try:
-                kind, payload, peer_id = self._queue.get(timeout=0.1)
+                items = [self._queue.get(timeout=0.1)]
             except queue.Empty:
                 continue
-            try:
-                with self._mtx:
-                    if kind == "timeout":
-                        self.wal.write(payload)
-                        self._handle_timeout(payload)
-                    elif kind == "internal":
-                        # fsync own messages before acting (state.go:774).
-                        self.wal.write_sync(payload)
-                        fail.fail()  # kill-point: own msg durable, unprocessed (state.go:787)
-                        self._handle_msg(payload, "")
+            # Opportunistic drain: under vote storms (large validator sets,
+            # gossip bursts) the queue holds many VoteMessages — pre-verify
+            # their signatures in ONE device batch so the serial per-vote
+            # checks below become verified-cache hits. No reordering, no
+            # added latency: only what is ALREADY queued is drained.
+            while len(items) < 256:
+                try:
+                    items.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            # Never delay a drained timeout or own-message behind a device
+            # call: round progression (and the WAL fsync of own msgs) must
+            # not wait on a possibly-slow backend. Note ApplyBlock already
+            # rides the device for commit verification, so prebatching adds
+            # no NEW device dependency to consensus — only this ordering
+            # hazard, which the guard removes.
+            if len(items) >= 8 and all(k == "peer" for k, _, _ in items):
+                self._prebatch_vote_signatures(items)
+            for kind, payload, peer_id in items:
+                try:
+                    with self._mtx:
+                        if kind == "timeout":
+                            self.wal.write(payload)
+                            self._handle_timeout(payload)
+                        elif kind == "internal":
+                            # fsync own messages before acting (state.go:774).
+                            self.wal.write_sync(payload)
+                            fail.fail()  # kill-point: own msg durable, unprocessed (state.go:787)
+                            self._handle_msg(payload, "")
+                        else:
+                            self.wal.write(payload)
+                            self._handle_msg(payload, peer_id)
+                except Exception:
+                    if self.logger:
+                        self.logger.error(
+                            f"consensus failure: {traceback.format_exc()}"
+                        )
                     else:
-                        self.wal.write(payload)
-                        self._handle_msg(payload, peer_id)
-            except Exception:
-                if self.logger:
-                    self.logger.error(
-                        f"consensus failure: {traceback.format_exc()}"
-                    )
-                else:
-                    traceback.print_exc()
+                        traceback.print_exc()
+
+    def _prebatch_vote_signatures(self, items) -> None:
+        """Batch-verify the signatures of queued peer votes (crypto only —
+        every protocol check still runs in _try_add_vote; invalid sigs are
+        simply not cached and fail there as before). A pure optimization:
+        errors here must never disturb the state machine."""
+        try:
+            from cometbft_tpu.crypto import ed25519 as _ed
+
+            votes = []
+            for kind, payload, _ in items:
+                if kind == "peer" and isinstance(payload, VoteMessage):
+                    votes.append(payload.vote)
+            if len(votes) < 8:
+                return
+            vals = self.state.validators
+            bv = _ed.BatchVerifier()
+            for v in votes:
+                if not (0 <= v.validator_index < vals.size()):
+                    continue
+                val = vals.validators[v.validator_index]
+                if val.address != v.validator_address or not isinstance(
+                    val.pub_key, _ed.PubKey
+                ):
+                    continue
+                if len(v.signature) != _ed.SIGNATURE_SIZE:
+                    continue
+                bv.add(val.pub_key, v.sign_bytes(self.state.chain_id), v.signature)
+            if len(bv) >= 8:
+                bv.verify()
+        except Exception:
+            pass
 
     def _handle_msg(self, msg, peer_id: str) -> None:
         """state.go:810-880 handleMsg."""

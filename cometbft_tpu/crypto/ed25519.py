@@ -70,15 +70,26 @@ class PubKey(crypto.PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE or len(self._bytes) != PUB_KEY_SIZE:
             return False
+        # The verified-triple cache serves single verifies too: the
+        # consensus loop batch-pre-verifies drained vote queues and fast
+        # sync pre-verifies block windows, so the per-vote/per-commit
+        # checks that follow land here already proven.
+        key = self._bytes + sig + bytes(msg)
+        if key in _verified:
+            return True
         handle = _cached_pubkey(self._bytes)
         if handle is not None:
             try:
                 handle.verify(sig, msg)
+                _verified_put(key)
                 return True
             except InvalidSignature:
                 pass
         # Fast path rejected: settle edge cases under exact ZIP-215 rules.
-        return ed25519_pure.verify_zip215(self._bytes, msg, sig)
+        ok = ed25519_pure.verify_zip215(self._bytes, msg, sig)
+        if ok:
+            _verified_put(key)
+        return ok
 
     def type(self) -> str:
         return KEY_TYPE

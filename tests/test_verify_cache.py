@@ -80,6 +80,78 @@ def test_invalid_sig_is_never_cached(counting_backend):
     assert counting_backend.calls == 2
 
 
+def test_single_verify_populates_and_consults_cache():
+    priv = ed25519.gen_priv_key_from_secret(b"single")
+    pub = priv.pub_key()
+    msg, sig = b"one-shot", priv.sign(b"one-shot")
+    key = pub.bytes() + sig + msg
+    assert key not in ed25519._verified
+    assert pub.verify_signature(msg, sig)
+    assert key in ed25519._verified, "valid single verify must cache"
+    # a cached triple short-circuits (observable: even a poisoned pubkey
+    # handle cache cannot make it fail)
+    assert pub.verify_signature(msg, sig)
+    # invalid never lands in the cache
+    bad = b"\x01" * 64
+    assert not pub.verify_signature(msg, bad)
+    assert pub.bytes() + bad + msg not in ed25519._verified
+
+
+def test_consensus_prebatch_warms_cache(counting_backend):
+    """_prebatch_vote_signatures on a drained queue of vote messages puts
+    every valid signature in the cache with one backend call; the serial
+    _try_add_vote verification then runs cache-hot."""
+    from cometbft_tpu.consensus import messages as cmsg
+    from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+    from cometbft_tpu.types.block import PRECOMMIT_TYPE
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.state import make_genesis_state
+
+    pvs = [MockPV() for _ in range(16)]
+    gen = GenesisDoc(
+        chain_id="prebatch-chain",
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    state = make_genesis_state(gen)
+
+    class FakeCS:
+        pass
+
+    cs = FakeCS()
+    cs.state = state
+    cs.logger = None
+    from cometbft_tpu.consensus.state import ConsensusState
+
+    bid = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x07" * 32))
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    items = []
+    # indices must follow the SORTED validator-set order, not genesis order
+    for idx, val in enumerate(state.validators.validators):
+        pv = pv_by_addr[val.address]
+        v = Vote(
+            type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+            timestamp=Time(1700000001, idx),
+            validator_address=pv.address(), validator_index=idx,
+        )
+        v = pv.sign_vote("prebatch-chain", v)
+        items.append(("peer", cmsg.VoteMessage(v), "p"))
+    ConsensusState._prebatch_vote_signatures(cs, items)
+    assert counting_backend.calls == 1
+    assert counting_backend.sigs == 16
+    # every vote now verifies without further backend traffic
+    for _, m, _ in items:
+        val = state.validators.validators[m.vote.validator_index]
+        assert val.pub_key.verify_signature(
+            m.vote.sign_bytes("prebatch-chain"), m.vote.signature
+        )
+    assert counting_backend.calls == 1
+
+
 def test_blocksync_prefetch_batches_window(counting_backend):
     """Build a 12-block chain for a 4-validator set, feed it to a blocksync
     reactor's pool, and sync: the window prefetch must cover many commits

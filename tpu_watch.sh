@@ -65,10 +65,15 @@ for i in $(seq 1 1400); do
       # least the two tractable modes (stacked, compact) each produced a
       # steady_ms line — a partial run (tunnel died mid-probe) retries;
       # planar timing out forever must not retrigger the probe.
-      if [ ! -f tpu_ab.log ] || [ "$(grep -c steady_ms tpu_ab.log)" -lt 2 ]; then
-        log "running fe-lowering A/B probe"
+      AB_TRIES=$(cat .tpu_ab_tries 2>/dev/null || echo 0)
+      if { [ ! -f tpu_ab.log ] || [ "$(grep -c steady_ms tpu_ab.log)" -lt 2 ]; } \
+         && [ "$AB_TRIES" -lt 3 ]; then
+        echo $((AB_TRIES + 1)) > .tpu_ab_tries
+        log "running fe-lowering A/B probe (attempt $((AB_TRIES + 1)))"
         # Fresh log per probe: --best must reflect THIS kernel build, not
         # steady_ms lines from superseded code in an append-only history.
+        # The attempt counter bounds re-probing when a mode persistently
+        # fails to produce its steady_ms line.
         [ -f tpu_ab.log ] && mv tpu_ab.log tpu_ab.log.1
         timeout 1800 python -u tpu_ab.py > tpu_ab.log 2>> tpu_watch.log
         log "A/B probe done"
@@ -80,12 +85,14 @@ for i in $(seq 1 1400); do
                timeout 60 python tpu_ab.py --best 2>/dev/null)
         if [ -n "$BEST" ] && [ "$BEST" != "stacked" ]; then
           log "A/B winner is $BEST; re-running bench with it"
-          echo "$BEST" > .tpu_fe_mode
           CMTPU_FE_MODE="$BEST" timeout 1500 python -u bench.py \
             > tpu_bench_alt.out 2>> tpu_watch.log
-          env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu timeout 60 \
-            python - <<'PYEOF' >> tpu_watch.log 2>&1
-import json
+          # Adopt the mode ONLY if the full bench agrees it is better
+          # (microbench winners can lose end-to-end); otherwise clear any
+          # stale sticky mode so later runs use the default.
+          AB_BEST="$BEST" env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+            timeout 60 python - <<'PYEOF' >> tpu_watch.log 2>&1
+import json, os
 def val(path):
     try:
         for line in open(path):
@@ -102,7 +109,14 @@ def val(path):
 cur, alt = val("tpu_bench_latest.json"), val("tpu_bench_alt.out")
 if alt and (cur is None or alt["value"] < cur["value"]):
     open("tpu_bench_latest.json", "w").write(json.dumps(alt) + "\n")
-    print(f"[watch] alt-mode bench better ({alt['value']} ms); kept")
+    open(".tpu_fe_mode", "w").write(os.environ["AB_BEST"] + "\n")
+    print(f"[watch] alt-mode bench better ({alt['value']} ms); mode kept")
+else:
+    try:
+        os.remove(".tpu_fe_mode")
+    except OSError:
+        pass
+    print("[watch] alt-mode bench not better; default mode stays")
 PYEOF
         fi
       fi
