@@ -120,9 +120,16 @@ class JSONRPCServer:
         return self._handle_single(req, ws)
 
     def _handle_single(self, req: dict, ws):
+        if not isinstance(req, dict):
+            return _error_response(
+                None, INVALID_REQUEST, "request must be an object", None
+            )
         rpc_id = req.get("id")
         method = req.get("method", "")
-        params = req.get("params") or {}
+        params = req.get("params")
+        params = {} if params is None else params
+        if not isinstance(method, str) or not isinstance(params, (dict, list)):
+            return _error_response(rpc_id, INVALID_REQUEST, "malformed request", None)
         if isinstance(params, list):
             return _error_response(
                 rpc_id, INVALID_PARAMS, "positional params not supported", None

@@ -101,6 +101,9 @@ def test_rpc_server_survives_malformed_input(net):
         b'{"jsonrpc": "2.0", "id": 1, "method": "block", "params": [1, 2, 3, 4]}',
         b'{"jsonrpc": "2.0", "id": {}, "method": "status", "params": "bogus"}',
         b'{"jsonrpc": "2.0", "id": 1, "method": "abci_query", "params": {"data": "zz-not-hex"}}',
+        b"[1, 2, 3]",  # batch body with non-object entries
+        b'[{"jsonrpc": "2.0", "id": 1, "method": "status"}, null, "x"]',
+        b'{"jsonrpc": "2.0", "id": 1, "method": 42, "params": 7}',
         b"\xff\xfe garbage \x00\x01" * 50,
         json.dumps({"jsonrpc": "2.0", "id": 1, "method": "tx_search",
                     "query": "malformed ==== query"}).encode(),
