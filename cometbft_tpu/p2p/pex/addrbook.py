@@ -326,18 +326,43 @@ class AddrBook:
         os.replace(tmp, path)
 
     def load(self, path: str) -> None:
-        with open(path) as f:
-            dump = json.load(f)
-        self._key = bytes.fromhex(dump.get("key", self._key.hex()))
-        for e in dump.get("addrs", []):
+        """Load the persisted book. The book is a peer-discovery CACHE, not
+        consensus state: a corrupt file must not stop node boot (the Go
+        reference errors out and operators end up deleting the file by
+        hand). On corruption the file is set aside as <path>.corrupt for
+        diagnosis and the node starts with an empty book."""
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+            if not isinstance(dump, dict):
+                raise ValueError("addrbook dump must be a JSON object")
+            # Validate EVERYTHING before mutating the book: the key seeds
+            # bucket placement, and adopting it from a file we then reject
+            # as corrupt would let a tampered file steer bucketing.
+            key = bytes.fromhex(dump.get("key", self._key.hex()))
+            entries = dump.get("addrs", [])
+            if not isinstance(entries, list):
+                raise ValueError("addrbook addrs must be a list")
+        except (ValueError, TypeError, AttributeError):
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+            return
+        self._key = key
+        for e in entries:
+            if not isinstance(e, dict):
+                continue
             try:
                 addr = NetAddress.parse(e["addr"])
-            except (ValueError, KeyError):
+                attempts = int(e.get("attempts", 0))
+                last_success = float(e.get("last_success", 0))
+            except (ValueError, KeyError, TypeError):
                 continue
             self.add_address(addr)
             ka = self._addrs.get(addr.id)
             if ka is not None:
-                ka.attempts = int(e.get("attempts", 0))
-                ka.last_success = float(e.get("last_success", 0))
+                ka.attempts = attempts
+                ka.last_success = last_success
                 if e.get("bucket_type") == "old":
                     self.mark_good(addr.id)

@@ -127,7 +127,10 @@ class JSONRPCServer:
         rpc_id = req.get("id")
         method = req.get("method", "")
         params = req.get("params")
-        params = {} if params is None else params
+        # None and the common client default `[]` both mean "no params";
+        # non-empty positional lists are rejected below with the specific
+        # INVALID_PARAMS message.
+        params = {} if params is None or params == [] else params
         if not isinstance(method, str) or not isinstance(params, (dict, list)):
             return _error_response(rpc_id, INVALID_REQUEST, "malformed request", None)
         if isinstance(params, list):

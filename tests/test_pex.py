@@ -72,6 +72,26 @@ def test_addrbook_persistence_roundtrip(tmp_path):
     assert loaded._addrs[na(3).id].bucket_type == "old"
 
 
+def test_addrbook_corrupt_file_does_not_stop_boot(tmp_path):
+    """A corrupt persisted book (a discovery cache, not consensus state)
+    must yield an empty book + a .corrupt diagnostic file, not an
+    exception out of node construction."""
+    import os
+
+    for blob in (b"{", b"[1, 2]", b'{"key": "zz-not-hex"}',
+                 b'{"addrs": {"not": "a list"}}', b'{"addrs": [42]}'):
+        path = str(tmp_path / "book.json")
+        with open(path, "wb") as f:
+            f.write(blob)
+        book = AddrBook(path, strict=True)
+        assert book.size() == 0
+        if blob != b'{"addrs": [42]}':  # [42] is a valid dump, entry skipped
+            assert os.path.exists(path + ".corrupt")
+        for p in (path, path + ".corrupt"):
+            if os.path.exists(p):
+                os.unlink(p)
+
+
 def test_pex_wire_codec():
     kind, _ = decode_pex_message(encode_pex_request())
     assert kind == "request"
