@@ -47,7 +47,10 @@ class NodeKey:
     def load(cls, path: str) -> "NodeKey":
         with open(path) as f:
             d = json.load(f)
-        return cls(ed25519.PrivKey(base64.b64decode(d["priv_key"]["value"])))
+        try:
+            return cls(ed25519.PrivKey(base64.b64decode(d["priv_key"]["value"])))
+        except (KeyError, TypeError) as e:
+            raise ValueError(f"corrupt node key {path}: {e}") from None
 
     @classmethod
     def load_or_gen(cls, path: str) -> "NodeKey":

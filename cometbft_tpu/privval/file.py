@@ -93,6 +93,10 @@ class LastSignState:
     def load(cls, path: str) -> "LastSignState":
         with open(path) as f:
             d = json.load(f)
+        if not isinstance(d, dict):
+            # Loud, typed failure: this file IS the double-sign guard —
+            # callers must never be tempted to catch-and-regenerate.
+            raise ValueError(f"corrupt last-sign state {path}: not an object")
         return cls(
             height=int(d.get("height", "0")),
             round=d.get("round", 0),
