@@ -49,7 +49,9 @@ class NodeKey:
             d = json.load(f)
         try:
             return cls(ed25519.PrivKey(base64.b64decode(d["priv_key"]["value"])))
-        except (KeyError, TypeError) as e:
+        except (KeyError, TypeError, ValueError) as e:
+            # ValueError covers binascii.Error (bad base64) and wrong-length
+            # keys — the common corruption modes.
             raise ValueError(f"corrupt node key {path}: {e}") from None
 
     @classmethod

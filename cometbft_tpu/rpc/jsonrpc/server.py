@@ -176,6 +176,14 @@ class JSONRPCServer:
         finally:
             if self.ws_manager is not None:
                 self.ws_manager.remove(conn)
+            # The socket left websocket framing; letting the HTTP/1.1
+            # keep-alive loop reparse leftover bytes as a request would pin
+            # the thread on a dead (or hostile) connection.
+            handler.close_connection = True
+            try:
+                handler.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 def _wants_ws(fn) -> bool:
@@ -205,6 +213,10 @@ class WSConnection:
     def send_json(self, obj) -> None:
         self._write_frame(json.dumps(obj).encode())
 
+    # Bound inbound frames: a header may CLAIM up to 2^64 bytes; reading it
+    # would pin the connection thread and accumulate unbounded memory.
+    MAX_FRAME = 16 * 1024 * 1024
+
     def _read_frame(self):
         try:
             hdr = self._read_exact(2)
@@ -218,6 +230,9 @@ class WSConnection:
                 length = struct.unpack(">H", self._read_exact(2))[0]
             elif length == 127:
                 length = struct.unpack(">Q", self._read_exact(8))[0]
+            if length > self.MAX_FRAME:
+                self.open = False
+                return None
             mask = self._read_exact(4) if masked else b"\x00" * 4
             payload = bytearray(self._read_exact(length) or b"")
             for i in range(len(payload)):

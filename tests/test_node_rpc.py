@@ -126,6 +126,73 @@ def test_rpc_server_survives_malformed_input(net):
     assert "sync_info" in st
 
 
+def test_websocket_survives_malformed_frames(net):
+    """Raw-socket websocket fuzz: garbage frames, an absurd declared length,
+    and bad JSON must never kill the server; a clean connection afterwards
+    still round-trips a call."""
+    import base64 as b64
+    import socket as socketlib
+    import struct
+
+    port = net[0].rpc_port
+
+    def ws_connect():
+        s = socketlib.create_connection(("127.0.0.1", port), timeout=10)
+        key = b64.b64encode(b"0123456789abcdef").decode()
+        s.sendall(
+            (
+                f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+                f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                f"Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        resp = s.recv(4096)
+        assert b"101" in resp.split(b"\r\n", 1)[0]
+        return s
+
+    def frame(payload: bytes, opcode=0x1) -> bytes:
+        hdr = bytes([0x80 | opcode])
+        ln = len(payload)
+        mask = b"\x00\x00\x00\x00"
+        if ln < 126:
+            hdr += bytes([0x80 | ln])
+        else:
+            hdr += bytes([0x80 | 126]) + struct.pack(">H", ln)
+        return hdr + mask + payload
+
+    # volley 1: bad JSON + random bytes in valid frames
+    s = ws_connect()
+    s.sendall(frame(b"{not json"))
+    s.recv(4096)  # error response
+    s.sendall(frame(bytes(range(256))))
+    try:
+        s.recv(4096)
+    except OSError:
+        pass
+    s.close()
+
+    # volley 2: absurd declared length must CLOSE the connection promptly —
+    # a timeout here means the server left the frame-bomb socket hanging
+    s = ws_connect()
+    s.sendall(bytes([0x81, 0x80 | 127]) + struct.pack(">Q", 1 << 60) + b"\x00" * 4)
+    s.settimeout(10)
+    try:
+        got = s.recv(64)
+        assert got == b"", "server should close the frame-bomb connection"
+    except socketlib.timeout:
+        raise AssertionError("server left the frame-bomb connection hanging")
+    except OSError:
+        pass  # reset is an acceptable close
+    s.close()
+
+    # clean connection still works
+    s = ws_connect()
+    s.sendall(frame(json.dumps({"jsonrpc": "2.0", "id": 7, "method": "status", "params": {}}).encode()))
+    buf = s.recv(65536)
+    assert b"sync_info" in buf
+    s.close()
+
+
 def test_rpc_surface(net):
     node0 = net[0]
     port = node0.rpc_port
