@@ -207,7 +207,9 @@ class GrpcBackend(VerifyBackend):
         self._sock: socket.socket | None = None
         self._wlock = threading.Lock()  # serializes frame WRITES only
         self._plock = threading.Lock()  # connection + pending table
-        self._pending: dict[int, list] = {}  # id -> [Event, body | None]
+        # id -> [Event, body | None, owning socket]: the socket tag lets a
+        # dead connection's reader sweep fail ONLY its own waiters.
+        self._pending: dict[int, list] = {}
         self._next_id = 0
 
     def _connect_locked(self) -> None:
@@ -238,23 +240,29 @@ class GrpcBackend(VerifyBackend):
             if slot is not None:
                 slot[1] = body
                 slot[0].set()
-        # Connection died: fail every waiter so they can retry.
+        # Connection died: fail the waiters that belong to THIS socket so
+        # they can retry. A delayed cleanup must not sweep requests already
+        # registered on a replacement connection (that race turned one
+        # reconnect into a spurious second failure).
         with self._plock:
             if self._sock is sock:
                 self._sock = None
-            pending, self._pending = dict(self._pending), {}
-        for slot in pending.values():
+            dead = {k: v for k, v in self._pending.items() if v[2] is sock}
+            for k in dead:
+                del self._pending[k]
+        for slot in dead.values():
             slot[0].set()
 
     def _call_once(self, method: str, payload: bytes) -> bytes:
-        slot = [threading.Event(), None]
+        slot = [threading.Event(), None, None]
         with self._plock:
             if self._sock is None:
                 self._connect_locked()
             self._next_id += 1
             req_id = self._next_id
-            self._pending[req_id] = slot
             sock = self._sock
+            slot[2] = sock
+            self._pending[req_id] = slot
         req = _encode_request(req_id, method, payload)
         try:
             with self._wlock:
@@ -262,13 +270,17 @@ class GrpcBackend(VerifyBackend):
         except OSError as e:
             with self._plock:
                 self._pending.pop(req_id, None)
-            raise ConnectionError(str(e)) from e
+            err = ConnectionError(str(e))
+            err.sock = sock  # which connection failed (see _call)
+            raise err from e
         if not slot[0].wait(self.timeout_s):
             with self._plock:
                 self._pending.pop(req_id, None)
             raise TimeoutError(f"sidecar {method} timed out")
         if slot[1] is None:
-            raise ConnectionError("sidecar connection lost mid-request")
+            err = ConnectionError("sidecar connection lost mid-request")
+            err.sock = sock
+            raise err
         return slot[1]
 
     def _call(self, method: str, payload: bytes) -> bytes:
@@ -276,9 +288,15 @@ class GrpcBackend(VerifyBackend):
             try:
                 body = self._call_once(method, payload)
                 break
-            except ConnectionError:
+            except ConnectionError as e:
+                # Tear down only the connection that actually failed: a
+                # thread handling a stale failure must not close the
+                # replacement another thread just established.
+                failed = getattr(e, "sock", None)
                 with self._plock:
-                    if self._sock is not None:
+                    if self._sock is not None and (
+                        failed is None or self._sock is failed
+                    ):
                         try:
                             self._sock.close()
                         except OSError:
