@@ -32,9 +32,12 @@ def home_with_chain(tmp_path_factory):
     while time.time() < deadline and node.block_store.height() < 4:
         time.sleep(0.05)
     assert node.block_store.height() >= 4
-    height = node.block_store.height()
     node.stop()
     time.sleep(0.2)
+    # Capture the height AFTER the node is fully stopped: consensus can
+    # commit one more block between a pre-stop read and stop(), making
+    # the offline tools' "store height N" assertions flake.
+    height = node.block_store.height()
     return home, height
 
 
