@@ -555,6 +555,9 @@ def cpu_fallback() -> None:
     log("cpu fallback: measuring")
     best = float("inf")
     for _ in range(3):
+        # The verified-triple cache would turn reps 2..3 into dict lookups;
+        # this number must measure real OpenSSL + hashlib work every rep.
+        ed25519._verified.clear()
         t1 = time.perf_counter()
         ok = all(k.verify_signature(m, s) for k, m, s in zip(keys, msgs, sigs))
         hash_from_byte_slices(txs)
