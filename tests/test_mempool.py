@@ -1,0 +1,99 @@
+"""CListMempool unit coverage (reference: mempool/clist_mempool_test.go):
+admission, cache semantics, size/byte limits, committed-tx removal, and —
+previously untested anywhere — the post-commit RECHECK that evicts txs the
+app no longer accepts."""
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool.clist_mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+)
+
+
+class CounterApp(abci.Application):
+    """Accepts a tx iff its integer value >= the app's floor — commits can
+    raise the floor, invalidating older pending txs on recheck."""
+
+    def __init__(self):
+        self.floor = 0
+
+    def check_tx(self, req):
+        try:
+            v = int(req.tx.decode())
+        except ValueError:
+            return abci.ResponseCheckTx(code=1, log="not a number")
+        if v < self.floor:
+            return abci.ResponseCheckTx(code=2, log="below floor")
+        return abci.ResponseCheckTx(code=0)
+
+
+def _mk(app=None, **cfg_kwargs):
+    app = app or CounterApp()
+    conns_client = LocalClientCreator(app).new_abci_client()
+    cfg = MempoolConfig(**cfg_kwargs)
+    return app, CListMempool(cfg, conns_client)
+
+
+def test_admission_reap_and_dedup():
+    app, mp = _mk()
+    for i in range(5):
+        mp.check_tx(b"%d" % i)
+    assert mp.size() == 5
+    assert mp.reap_max_txs(3) == [b"0", b"1", b"2"]
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"3")
+    # app-rejected tx never enters
+    mp.check_tx(b"nope")
+    assert mp.size() == 5
+
+
+def test_tx_too_large_and_full():
+    app, mp = _mk(max_tx_bytes=8, size=2, max_txs_bytes=1000)
+    with pytest.raises(ErrTxTooLarge):
+        mp.check_tx(b"123456789")
+    mp.check_tx(b"1")
+    mp.check_tx(b"2")
+    with pytest.raises(ErrMempoolIsFull):
+        mp.check_tx(b"3")
+
+
+def test_update_removes_committed_and_blocks_replay():
+    app, mp = _mk()
+    for i in range(4):
+        mp.check_tx(b"%d" % i)
+    mp.lock()
+    try:
+        mp.update(
+            1,
+            [b"0", b"1"],
+            [abci.ResponseDeliverTx(code=0), abci.ResponseDeliverTx(code=0)],
+            None,
+            None,
+        )
+    finally:
+        mp.unlock()
+    assert mp.size() == 2
+    assert mp.reap_max_txs(-1) == [b"2", b"3"]
+    with pytest.raises(ErrTxInCache):  # committed txs stay cached
+        mp.check_tx(b"0")
+
+
+def test_recheck_evicts_newly_invalid_txs():
+    app, mp = _mk()
+    for i in range(6):
+        mp.check_tx(b"%d" % i)
+    assert mp.size() == 6
+    # the commit raises the app floor: txs 0..3 become invalid
+    app.floor = 4
+    mp.lock()
+    try:
+        mp.update(1, [], [], None, None)
+    finally:
+        mp.unlock()
+    assert mp.reap_max_txs(-1) == [b"4", b"5"], "recheck must evict below-floor txs"
