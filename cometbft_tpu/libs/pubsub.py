@@ -18,8 +18,12 @@ from dataclasses import dataclass, field as dfield
 from typing import Any
 
 _COND_RE = re.compile(
+    # Quoted strings have NO escape sequences — matching the reference
+    # grammar (libs/pubsub/query), where a value is '...' of non-quote
+    # characters; a lone backslash-quote would otherwise parse but never
+    # unescape, silently mismatching.
     r"\s*([\w.\-/]+)\s*(>=|<=|=|<|>|\bCONTAINS\b|\bEXISTS\b)\s*"
-    r"('(?:[^'\\]|\\.)*'|[\w.\-:+TZ]*)\s*",
+    r"('[^']*'|[\w.\-:+TZ]*)\s*",
     re.IGNORECASE,
 )
 
@@ -80,6 +84,10 @@ class Query:
                 value = ""
             elif raw.startswith("'") and raw.endswith("'") and len(raw) >= 2:
                 value = raw[1:-1]
+            elif raw == "":
+                # a bare `key=` has no value; only the quoted form '' means
+                # the empty string (the reference grammar requires a value)
+                raise ValueError(f"failed to parse query condition: {part!r}")
             else:
                 value = raw
             conds.append(Condition(key, op, value))

@@ -1,0 +1,74 @@
+"""Pubsub query DSL (reference: libs/pubsub/query/query_test.go shapes):
+parsing, AND-splitting with quoted strings, every operator, numeric
+comparison semantics, EXISTS, multi-valued attributes, and rejection of
+malformed queries."""
+
+import pytest
+
+from cometbft_tpu.libs.pubsub import Query
+
+
+def q(s):
+    return Query(s)
+
+
+def test_equality_and_quoting():
+    assert q("tm.event='Tx'").matches({"tm.event": ["Tx"]})
+    assert not q("tm.event='Tx'").matches({"tm.event": ["NewBlock"]})
+    assert not q("tm.event='Tx'").matches({})
+    # quoted value containing AND must not split
+    qq = q("note.text='to AND fro' AND tm.event='Tx'")
+    assert qq.matches({"note.text": ["to AND fro"], "tm.event": ["Tx"]})
+    assert len(qq.conditions) == 2
+
+
+def test_and_is_case_insensitive_and_requires_word_boundary():
+    qq = q("a='1' and b='2'")
+    assert len(qq.conditions) == 2
+    # 'AND' inside an identifier-ish value must not split
+    qq = q("cmd='BANDAGE'")
+    assert len(qq.conditions) == 1
+    assert qq.matches({"cmd": ["BANDAGE"]})
+
+
+def test_numeric_comparisons():
+    attrs = {"tx.height": ["42"]}
+    assert q("tx.height>41").matches(attrs)
+    assert q("tx.height>=42").matches(attrs)
+    assert not q("tx.height>42").matches(attrs)
+    assert q("tx.height<43").matches(attrs)
+    assert q("tx.height<=42").matches(attrs)
+    # non-numeric value never satisfies a numeric comparison
+    assert not q("tx.height>41").matches({"tx.height": ["not-a-number"]})
+
+
+def test_contains_and_exists():
+    attrs = {"account.owner": ["Ivan Ivanov"]}
+    assert q("account.owner CONTAINS 'Ivan'").matches(attrs)
+    assert not q("account.owner CONTAINS 'Petya'").matches(attrs)
+    assert q("account.owner EXISTS").matches(attrs)
+    assert not q("account.missing EXISTS").matches(attrs)
+
+
+def test_multivalued_attributes_any_match():
+    attrs = {"transfer.recipient": ["addr1", "addr2"]}
+    assert q("transfer.recipient='addr2'").matches(attrs)
+    assert not q("transfer.recipient='addr3'").matches(attrs)
+
+
+def test_all_conditions_must_hold():
+    attrs = {"tm.event": ["Tx"], "tx.height": ["5"]}
+    assert q("tm.event='Tx' AND tx.height=5").matches(attrs)
+    assert not q("tm.event='Tx' AND tx.height=6").matches(attrs)
+
+
+def test_malformed_queries_raise():
+    for bad in ("tm.event=", "=x", "height >>", "a='unterminated",
+                "a ISH 'x'", r"a='x\'y'"):
+        with pytest.raises(ValueError):
+            Query(bad)
+
+
+def test_empty_query_matches_everything():
+    assert q("").matches({"anything": ["x"]})
+    assert q("").matches({})
