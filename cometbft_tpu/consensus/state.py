@@ -843,6 +843,9 @@ class ConsensusState:
         if retain_height > 0:
             try:
                 self.block_store.prune_blocks(retain_height)
+                # the reference prunes state records alongside blocks
+                # (state/execution.go pruneBlocks -> Store().PruneStates)
+                self.block_exec.state_store.prune_states(retain_height)
             except Exception:
                 pass
         self._record_commit_metrics(block)

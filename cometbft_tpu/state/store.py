@@ -20,6 +20,7 @@ from cometbft_tpu.types.validator_set import ValidatorSet
 from cometbft_tpu.wire import proto as wire
 
 _STATE_KEY = b"stateKey"
+_PRUNED_TO_KEY = b"stateStorePrunedToKey"
 
 
 def _validators_key(height: int) -> bytes:
@@ -44,6 +45,11 @@ class StateStore:
         # save-state replay) — /block_results for older heights is gone
         # (state/store.go Options.DiscardABCIResponses).
         self.discard_abci_responses = discard_abci_responses
+        # Pruned floor: checkpoints below this height are gone; new pointer
+        # records must target the migrated checkpoint AT this height, or a
+        # save after pruning would write a dangling reference.
+        raw = self._db.get(_PRUNED_TO_KEY)
+        self._pruned_to = int(raw) if raw else 0
 
     # -- state ---------------------------------------------------------------
 
@@ -92,7 +98,9 @@ class StateStore:
         if height == last_height_changed:
             payload = {"h": height, "set": vals.encode().hex()}
         else:
-            payload = {"h": last_height_changed}
+            # Never point below the pruned floor (the checkpoint there was
+            # migrated to the floor height by prune_states).
+            payload = {"h": max(last_height_changed, self._pruned_to)}
         self._db.set(_validators_key(height), json.dumps(payload).encode())
 
     def load_validators(self, height: int) -> ValidatorSet:
@@ -124,7 +132,7 @@ class StateStore:
         if height == last_height_changed:
             payload = {"h": height, "params": params.encode().hex()}
         else:
-            payload = {"h": last_height_changed}
+            payload = {"h": max(last_height_changed, self._pruned_to)}
         self._db.set(_params_key(height), json.dumps(payload).encode())
 
     def load_consensus_params(self, height: int) -> ConsensusParams:
@@ -157,17 +165,60 @@ class StateStore:
     def prune_states(self, retain_height: int) -> None:
         """state/store.go PruneStates. Keys are textual "prefix:height", so a
         full prefix scan with numeric parsing is required (bytewise ranges
-        over decimal strings would skip e.g. ':2'..':9' when pruning to 10)."""
+        over decimal strings would skip e.g. ':2'..':9' when pruning to 10).
+
+        Validator-set and params records are stored SPARSELY: unchanged
+        heights hold a pointer to the last-changed checkpoint, which may sit
+        below retain_height. The checkpoint is migrated to retain_height as
+        a full record BEFORE deleting (the reference's PruneStates does the
+        same), or every retained pointer would dangle."""
         if retain_height <= 0:
             raise ValueError("height must be greater than 0")
+        # Migrate checkpoints the retained range depends on. A failed load
+        # ABORTS the prune (the reference errors out too): silently
+        # proceeding would delete every record the retained range needs.
+        vals = self.load_validators(retain_height)
+        self._db.set(
+            _validators_key(retain_height),
+            json.dumps({"h": retain_height, "set": vals.encode().hex()}).encode(),
+        )
+        params = self.load_consensus_params(retain_height)
+        self._db.set(
+            _params_key(retain_height),
+            json.dumps(
+                {"h": retain_height, "params": params.encode().hex()}
+            ).encode(),
+        )
+        self._pruned_to = max(self._pruned_to, retain_height)
+        self._db.set(_PRUNED_TO_KEY, str(self._pruned_to).encode())
         for prefix in (b"validatorsKey:", b"consensusParamsKey:", b"abciResponsesKey:"):
-            for k, _ in list(self._db.iterator(prefix, prefix + b"\xff")):
+            for k, raw in list(self._db.iterator(prefix, prefix + b"\xff")):
                 try:
                     h = int(k.rsplit(b":", 1)[1])
                 except Exception:
                     continue
                 if h < retain_height:
                     self._db.delete(k)
+                elif h > retain_height and prefix != b"abciResponsesKey:":
+                    # Retained pointer records that referenced a deleted
+                    # checkpoint now chase the migrated one. (Proposer-
+                    # priority restoration composes: incrementing from the
+                    # migrated checkpoint by h - retain equals incrementing
+                    # from the original by h - last_changed.)
+                    try:
+                        info = json.loads(raw)
+                    except ValueError:
+                        continue
+                    ptr = info.get("h")
+                    if (
+                        isinstance(ptr, int)
+                        and ptr < retain_height
+                        and "set" not in info
+                        and "params" not in info
+                    ):
+                        self._db.set(
+                            k, json.dumps({"h": retain_height}).encode()
+                        )
 
 
 class NoValidatorsError(Exception):

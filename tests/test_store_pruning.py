@@ -1,0 +1,90 @@
+"""Block/state store pruning (reference: store/store.go PruneBlocks +
+state/store.go PruneStates): retained heights stay loadable, pruned ones
+are fully gone (meta, parts, commits, hash index), base/height advance,
+and pruning is idempotent/height-checked."""
+
+import pytest
+
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import GenesisDoc, GenesisValidator, Time
+from cometbft_tpu.types.priv_validator import MockPV
+from tests.test_blocksync import CHAIN_ID, _populated_chain
+
+
+@pytest.fixture
+def chain():
+    pvs = [MockPV() for _ in range(3)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    state, block_store, executor = _populated_chain(pvs, gen, 10)
+    return state, block_store, executor.state_store
+
+
+def test_prune_blocks(chain):
+    state, bs, _ = chain
+    assert bs.base() == 1 and bs.height() == 10
+    blk5_hash = bs.load_block(5).hash()
+    pruned = bs.prune_blocks(6)
+    assert pruned == 5
+    assert bs.base() == 6 and bs.height() == 10
+    for h in range(1, 6):
+        assert bs.load_block(h) is None
+        assert bs.load_block_meta(h) is None
+        assert bs.load_block_commit(h) is None
+        assert bs.load_block_part(h, 0) is None
+    assert bs.load_block_by_hash(blk5_hash) is None
+    for h in range(6, 11):
+        assert bs.load_block(h) is not None
+    for h in range(6, 10):  # the tip's commit only exists as seen-commit
+        assert bs.load_block_commit(h) is not None
+    assert bs.load_seen_commit(10) is not None
+    # idempotent / no-op when retain <= base
+    assert bs.prune_blocks(6) == 0
+    # cannot prune past the store height
+    with pytest.raises(Exception):
+        bs.prune_blocks(99)
+
+
+def test_prune_states_migrates_sparse_checkpoints(chain):
+    """The validator/params records are stored sparsely (pointer to the
+    last-changed checkpoint, typically height 1). Pruning must migrate the
+    checkpoint and rewrite retained pointers — and the restored proposer
+    priorities must be IDENTICAL to the pre-prune answer (increment
+    composition), or proposer selection would diverge after pruning."""
+    state, _, ss = chain
+    before = {h: ss.load_validators(h) for h in range(7, 11)}
+    params_before = {h: ss.load_consensus_params(h) for h in range(7, 11)}
+    ss.prune_states(7)
+    for h in range(7, 11):
+        after = ss.load_validators(h)
+        assert after.encode() == before[h].encode(), f"valset diverged at {h}"
+        assert [v.proposer_priority for v in after.validators] == [
+            v.proposer_priority for v in before[h].validators
+        ], f"priorities diverged at {h}"
+        assert ss.load_consensus_params(h).encode() == params_before[h].encode()
+    with pytest.raises(Exception):
+        ss.load_validators(2)
+    with pytest.raises(Exception):
+        ss.load_consensus_params(2)
+    # A SAVE after pruning must not write a pointer below the pruned floor
+    # (state.last_height_validators_changed still says 1): the next height's
+    # records have to stay loadable.
+    ss.save(state)
+    h_next = state.last_block_height + 1 + 1  # save() writes next_validators
+    assert ss.load_validators(h_next) is not None
+    assert ss.load_consensus_params(state.last_block_height + 1) is not None
+
+
+def test_prune_states_aborts_when_target_missing(chain):
+    state, _, ss = chain
+    with pytest.raises(Exception):
+        ss.prune_states(99)  # no checkpoint loadable at 99
+    # nothing was deleted by the aborted prune
+    assert ss.load_validators(3) is not None
