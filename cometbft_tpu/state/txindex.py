@@ -57,10 +57,28 @@ class KVTxIndexer:
         """Condition-driven scan (kv.go match): supports key=value AND ... plus
         tx.height ranges via the pubsub Query semantics."""
         q = Query(query)
-        # Start from the first indexable equality condition.
-        eq = next((c for c in q.conditions if c.op == "="), None)
         results: list[dict] = []
         seen: set[bytes] = set()
+        # tx.hash has a PRIMARY record, not a secondary event key: resolve
+        # it directly — case-insensitively, and WITHOUT applying the other
+        # conditions, exactly like the reference's hash fast path
+        # (kv.go:211-224 returns the Get result unconditionally).
+        hash_eq = next(
+            (c for c in q.conditions if c.op == "=" and c.key == "tx.hash"), None
+        )
+        if hash_eq is not None:
+            try:
+                rec = self.get(bytes.fromhex(hash_eq.value))
+            except ValueError:
+                return []
+            return [rec] if rec else []
+        # Start from the first condition with a secondary index — tx.height
+        # has none (it lives on the primary record), so it cannot drive the
+        # scan.
+        eq = next(
+            (c for c in q.conditions if c.op == "=" and c.key != "tx.height"),
+            None,
+        )
         if eq is not None:
             prefix = b"txev:%s=%s:" % (eq.key.encode(), eq.value.encode())
             for _, h in self._db.iterator(prefix, prefix + b"\xff"):

@@ -1,0 +1,87 @@
+"""KVTxIndexer / KVBlockIndexer search semantics (reference:
+state/txindex/kv/kv_test.go shapes): hash lookup, equality-driven scans,
+height ranges, multi-condition AND, multi-valued events, result ordering,
+and a reindex of the same tx staying idempotent."""
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.state.txindex import KVBlockIndexer, KVTxIndexer
+from cometbft_tpu.types.tx import tx_hash
+
+
+@pytest.fixture
+def idx():
+    ix = KVTxIndexer(MemDB())
+    # three txs across two heights with transfer events
+    entries = [
+        (5, 0, b"tx-a", {"transfer.sender": ["alice"], "transfer.amount": ["10"]}),
+        (5, 1, b"tx-b", {"transfer.sender": ["bob"], "transfer.amount": ["7"]}),
+        (9, 0, b"tx-c", {"transfer.sender": ["alice", "carol"], "transfer.amount": ["99"]}),
+    ]
+    for h, i, tx, ev in entries:
+        ix.index(h, i, tx, abci.ResponseDeliverTx(code=0, data=b"", log=""), ev)
+    return ix
+
+
+def test_get_by_hash(idx):
+    rec = idx.get(tx_hash(b"tx-b"))
+    assert rec is not None and rec["height"] == "5" and rec["index"] == 1
+    assert idx.get(b"\x00" * 32) is None
+
+
+def test_search_by_event_equality(idx):
+    got = idx.search("transfer.sender='alice'")
+    assert [r["height"] for r in got] == ["5", "9"]
+    assert idx.search("transfer.sender='nobody'") == []
+
+
+def test_search_multivalued_attribute(idx):
+    got = idx.search("transfer.sender='carol'")
+    assert len(got) == 1 and got[0]["height"] == "9"
+
+
+def test_search_height_range_and_and(idx):
+    got = idx.search("transfer.sender='alice' AND tx.height>6")
+    assert len(got) == 1 and got[0]["height"] == "9"
+    got = idx.search("tx.height<=5")
+    assert len(got) == 2
+    got = idx.search("transfer.amount>=10 AND transfer.sender='alice'")
+    assert [r["height"] for r in got] == ["5", "9"]
+
+
+def test_search_by_hash_condition(idx):
+    h = tx_hash(b"tx-c").hex().upper()
+    got = idx.search(f"tx.hash='{h}'")
+    assert len(got) == 1 and got[0]["index"] == 0
+    # case-insensitive (bytes.fromhex), like the reference's hash decode
+    got = idx.search(f"tx.hash='{h.lower()}'")
+    assert len(got) == 1
+    # parity quirk: the reference returns the hash lookup UNCONDITIONALLY,
+    # ignoring other AND conditions (kv.go:211-224)
+    got = idx.search(f"tx.hash='{h}' AND tx.height=999")
+    assert len(got) == 1
+    assert idx.search("tx.hash='zz-not-hex'") == []
+
+
+def test_search_by_height_equality_full_scan(idx):
+    """tx.height has no secondary index; an equality on it must fall back
+    to the primary scan instead of probing a nonexistent event key."""
+    got = idx.search("tx.height=5")
+    assert [(r["height"], r["index"]) for r in got] == [("5", 0), ("5", 1)]
+
+
+def test_ordering_and_reindex_idempotent(idx):
+    # re-index tx-a (e.g. replayed during reindex-event): still one record
+    idx.index(5, 0, b"tx-a", abci.ResponseDeliverTx(code=0), {"transfer.sender": ["alice"]})
+    got = idx.search("transfer.sender='alice'")
+    assert [(r["height"], r["index"]) for r in got] == [("5", 0), ("9", 0)]
+
+
+def test_block_indexer_search():
+    bx = KVBlockIndexer(MemDB())
+    bx.index(3, {"block.shape": ["square"]})
+    bx.index(8, {"block.shape": ["round"]})
+    assert bx.search("block.shape='round'") == [8]
+    assert bx.search("block.shape='round' AND block.height>8") == []
