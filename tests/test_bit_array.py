@@ -1,0 +1,70 @@
+"""BitArray semantics (reference: libs/bits/bit_array_test.go shapes) —
+the structure consensus gossip trusts to decide which votes/parts a peer
+still needs. sub() in particular must follow the reference's asymmetric
+size rule."""
+
+import pytest
+
+from cometbft_tpu.libs.bit_array import BitArray
+
+
+def ba(s: str) -> BitArray:
+    b = BitArray(len(s))
+    for i, ch in enumerate(s):
+        if ch == "1":
+            b.set_index(i, True)
+    return b
+
+
+def bits(b: BitArray) -> str:
+    return "".join("1" if b.get_index(i) else "0" for i in range(b.size))
+
+
+def test_set_get_bounds():
+    b = BitArray(5)
+    assert b.set_index(3, True)
+    assert b.get_index(3)
+    assert not b.get_index(4)
+    assert not b.set_index(9, True)  # out of range: no-op, False
+    assert not b.get_index(9)
+
+
+def test_or_and_not():
+    x, y = ba("10101"), ba("11000")
+    assert bits(x.or_with(y)) == "11101"
+    assert bits(x.and_with(y)) == "10000"
+    assert bits(x.not_()) == "01010"
+    # or grows to the larger size
+    assert bits(ba("101").or_with(ba("01011"))) == "11111"
+    # and shrinks to the smaller size
+    assert bits(ba("11111").and_with(ba("011"))) == "011"
+
+
+def test_sub_asymmetric_sizes():
+    # x - y: bits of x cleared where y is set; y's extra bits ignored
+    assert bits(ba("10101").sub(ba("11000"))) == "00101"
+    assert bits(ba("10101").sub(ba("11"))) == "00101"
+    assert bits(ba("101").sub(ba("11111"))) == "000"
+
+
+def test_pick_random_and_counts():
+    b = ba("00100100")
+    assert b.num_true_bits() == 2
+    seen = set()
+    for _ in range(50):
+        i, ok = b.pick_random()
+        assert ok and b.get_index(i)
+        seen.add(i)
+    assert seen == {2, 5}
+    empty = BitArray(4)
+    _, ok = empty.pick_random()
+    assert not ok
+    assert empty.is_empty() and not empty.is_full()
+    assert ba("111").is_full()
+
+
+def test_copy_is_independent():
+    x = ba("1010")
+    y = x.copy()
+    y.set_index(1, True)
+    assert bits(x) == "1010" and bits(y) == "1110"
