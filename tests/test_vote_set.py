@@ -1,0 +1,117 @@
+"""VoteSet 2/3 accounting (reference: types/vote_set_test.go shapes): the
+exact quorum boundary, nil-vs-block majorities, conflicting votes raising
+the evidence-surface error, duplicate adds, bad signatures, and the
+peer-maj23 bookkeeping that lets gossip track minority forks."""
+
+import pytest
+
+from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+from cometbft_tpu.types.block import PRECOMMIT_TYPE
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+
+CHAIN = "voteset-chain"
+
+
+@pytest.fixture
+def rig():
+    pvs = [MockPV() for _ in range(9)]  # 9 validators x 10 power = 90 total
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    from cometbft_tpu.state import make_genesis_state
+
+    vals = make_genesis_state(gen).validators
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    ordered = [pv_by_addr[v.address] for v in vals.validators]
+    vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+    return vs, ordered, vals
+
+
+def _vote(pv, idx, bid, nanos=0):
+    v = Vote(
+        type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+        timestamp=Time(1700000001, nanos),
+        validator_address=pv.address(), validator_index=idx,
+    )
+    return pv.sign_vote(CHAIN, v)
+
+
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+NIL = BlockID()
+
+
+def test_exact_two_thirds_boundary(rig):
+    vs, pvs, vals = rig
+    # 2/3 of 90 = 60: sixty power (6 votes) is NOT a majority; 70 is.
+    for i in range(6):
+        assert vs.add_vote(_vote(pvs[i], i, BID))
+    assert not vs.has_two_thirds_majority(), "exactly 2/3 must NOT be a majority"
+    assert not vs.has_two_thirds_any()
+    assert vs.add_vote(_vote(pvs[6], 6, BID))
+    assert vs.has_two_thirds_majority()
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == BID
+    assert vs.is_commit()
+
+
+def test_nil_majority_semantics(rig):
+    vs, pvs, _ = rig
+    for i in range(7):
+        vs.add_vote(_vote(pvs[i], i, NIL))
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj is not None and maj.is_zero()
+    # reference parity quirk: IsCommit is maj23 != nil (vote_set.go:424),
+    # which is TRUE even for a nil-block majority — consensus decides
+    # commits via TwoThirdsMajority + IsZero, not this predicate.
+    assert vs.is_commit()
+
+
+def test_two_thirds_any_without_single_majority(rig):
+    vs, pvs, _ = rig
+    other = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+    for i in range(4):
+        vs.add_vote(_vote(pvs[i], i, BID))
+    for i in range(4, 8):
+        vs.add_vote(_vote(pvs[i], i, other))
+    assert vs.has_two_thirds_any()
+    assert not vs.has_two_thirds_majority()
+
+
+def test_duplicate_add_is_noop_and_conflict_raises(rig):
+    vs, pvs, _ = rig
+    v = _vote(pvs[0], 0, BID)
+    assert vs.add_vote(v)
+    assert not vs.add_vote(v), "same vote again must report not-added"
+    other = BlockID(b"\x05" * 32, PartSetHeader(1, b"\x06" * 32))
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        vs.add_vote(_vote(pvs[0], 0, other, nanos=5))
+    assert ei.value.vote_a.block_id != ei.value.vote_b.block_id
+
+
+def test_bad_signature_and_wrong_index_rejected(rig):
+    vs, pvs, _ = rig
+    good = _vote(pvs[2], 2, BID)
+    from dataclasses import replace
+
+    assert vs.size() == 9  # Size() is the VALIDATOR count (vote_set.go:127)
+    with pytest.raises(Exception):
+        vs.add_vote(replace(good, signature=b"\x01" * 64))
+    with pytest.raises(Exception):
+        vs.add_vote(replace(good, validator_index=3))  # index/address mismatch
+    assert len(vs.list_votes()) == 0
+
+
+def test_peer_maj23_tracks_minority_fork(rig):
+    vs, pvs, _ = rig
+    fork = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32))
+    vs.add_vote(_vote(pvs[0], 0, fork))
+    vs.set_peer_maj23("peer-x", fork)
+    ba = vs.bit_array_by_block_id(fork)
+    assert ba is not None and ba.get_index(0)
