@@ -1,0 +1,99 @@
+"""ValidatorSet machinery (reference: types/validator_set_test.go — its
+largest test file): weighted proposer rotation fairness, priority
+centering/rescaling, and the update change-set rules (add, power change,
+removal via 0, rejection of bad change-sets)."""
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+
+
+def mkval(seed: bytes, power: int) -> Validator:
+    pub = ed25519.gen_priv_key_from_secret(seed).pub_key()
+    return Validator(pub.address(), pub, power)
+
+
+@pytest.fixture
+def vset():
+    return ValidatorSet([mkval(b"a", 10), mkval(b"b", 20), mkval(b"c", 30)])
+
+
+def test_weighted_proposer_rotation_fairness(vset):
+    """Over total_power rounds every validator proposes proportionally to
+    its power (the reference's round-robin invariant)."""
+    counts: dict[bytes, int] = {}
+    total = vset.total_voting_power()
+    for _ in range(total):
+        p = vset.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+        vset.increment_proposer_priority(1)
+    by_power = {v.address: v.voting_power for v in vset.validators}
+    assert counts == by_power, f"rotation not power-proportional: {counts}"
+
+
+def test_priorities_stay_centered(vset):
+    for _ in range(1000):
+        vset.increment_proposer_priority(1)
+    prios = [v.proposer_priority for v in vset.validators]
+    total = vset.total_voting_power()
+    assert max(prios) - min(prios) <= 2 * total
+    assert abs(sum(prios)) <= len(prios)  # centered near zero
+
+
+def test_update_change_set_add_update_remove(vset):
+    d = mkval(b"d", 15)
+    vset.update_with_change_set([d])
+    assert vset.size() == 4 and vset.total_voting_power() == 75
+    # power change
+    b_up = mkval(b"b", 5)
+    vset.update_with_change_set([b_up])
+    assert vset.total_voting_power() == 60
+    _, got = vset.get_by_address(b_up.address)
+    assert got.voting_power == 5
+    # removal via power 0
+    vset.update_with_change_set([mkval(b"a", 0)])
+    assert vset.size() == 3
+    assert not vset.has_address(mkval(b"a", 0).address)
+
+
+def test_update_rejects_bad_change_sets(vset):
+    # duplicate addresses in one change set
+    with pytest.raises(Exception):
+        vset.update_with_change_set([mkval(b"x", 5), mkval(b"x", 6)])
+    # deleting an unknown validator
+    with pytest.raises(Exception):
+        vset.update_with_change_set([mkval(b"ghost", 0)])
+    # negative power
+    with pytest.raises(Exception):
+        vset.update_with_change_set([mkval(b"y", -3)])
+    # removing everyone
+    with pytest.raises(Exception):
+        vset.update_with_change_set(
+            [mkval(b"a", 0), mkval(b"b", 0), mkval(b"c", 0)]
+        )
+
+
+def test_update_preserves_rotation_fairness(vset):
+    """After an update, rotation must still be power-proportional over a
+    full cycle (priorities of new entrants are penalized, not zeroed —
+    validator_set.go computeNewPriorities)."""
+    vset.update_with_change_set([mkval(b"d", 40)])
+    counts: dict[bytes, int] = {}
+    total = vset.total_voting_power()
+    for _ in range(total * 2):
+        p = vset.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+        vset.increment_proposer_priority(1)
+    by_power = {v.address: v.voting_power * 2 for v in vset.validators}
+    for addr, want in by_power.items():
+        assert abs(counts.get(addr, 0) - want) <= 2, (
+            f"unfair rotation after update: {counts} vs {by_power}"
+        )
+
+
+def test_hash_changes_with_membership(vset):
+    h0 = vset.hash()
+    vset.update_with_change_set([mkval(b"d", 1)])
+    assert vset.hash() != h0
