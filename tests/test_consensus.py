@@ -126,3 +126,60 @@ def test_wal_records_end_heights(net4, tmp_path):
         if isinstance(tm.msg, EndHeightMessage)
     ]
     assert 0 in heights and 1 in heights and 2 in heights
+
+
+def test_set_proposal_rejects_forged_and_bad_pol(tmp_path):
+    """defaultSetProposal's security gates, exercised directly: a proposal
+    not signed by the round's proposer must raise, as must an invalid POL
+    round; a stale height/round proposal is silently ignored (no state
+    change), and the genuine proposer's proposal lands."""
+    from dataclasses import replace
+
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.proposal import Proposal
+    from cometbft_tpu.types import BlockID
+    from cometbft_tpu.types.vote import VoteError
+
+    nodes = make_network(4, str(tmp_path))
+    cs = nodes[0][0]
+    pvs = [n[0].priv_validator for n in nodes]
+    try:
+        rs = cs.rs
+        proposer = rs.validators.get_proposer()
+        pv_by_addr = {pv.address(): pv for pv in pvs}
+        proposer_pv = pv_by_addr[proposer.address]
+        outsider_pv = next(
+            pv for pv in pvs if pv.address() != proposer.address
+        )
+        bid = BlockID(b"\x09" * 32, PartSetHeader(1, b"\x0a" * 32))
+
+        def mk_proposal(pv, pol_round=-1, height=None, round_=None):
+            p = Proposal(
+                height=height if height is not None else rs.height,
+                round=round_ if round_ is not None else rs.round,
+                pol_round=pol_round,
+                block_id=bid,
+                timestamp=Time(1700000002, 0),
+            )
+            return pv.sign_proposal(CHAIN_ID, p)
+
+        # forged: signed by a validator who is NOT this round's proposer
+        with pytest.raises(VoteError, match="signature"):
+            cs._set_proposal(mk_proposal(outsider_pv))
+        assert cs.rs.proposal is None
+
+        # invalid POL round (>= round)
+        with pytest.raises(VoteError, match="POL"):
+            cs._set_proposal(mk_proposal(proposer_pv, pol_round=rs.round))
+        assert cs.rs.proposal is None
+
+        # stale height: silently ignored
+        cs._set_proposal(mk_proposal(proposer_pv, height=rs.height + 5))
+        assert cs.rs.proposal is None
+
+        # the real proposer's proposal is accepted
+        cs._set_proposal(mk_proposal(proposer_pv))
+        assert cs.rs.proposal is not None
+    finally:
+        for cs_, _, _ in nodes:
+            cs_.stop()
