@@ -1,0 +1,112 @@
+"""Blocksync window prefetch across a validator-set change: the batching
+guard (header.validators_hash must equal the current set's hash) is the
+soundness condition of the one-dispatch-per-window optimization — a chain
+whose set changes mid-window must still sync correctly, with the changed
+blocks verified against the right set."""
+
+import base64
+
+import pytest
+
+from cometbft_tpu.abci.example.kvstore import PersistentKVStoreApplication
+from cometbft_tpu.blocksync.pool import _Requester
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import (
+    BlockID,
+    Commit,
+    GenesisDoc,
+    GenesisValidator,
+    Time,
+    Vote,
+)
+from cometbft_tpu.types.block import PRECOMMIT_TYPE
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import vote_to_commit_sig
+
+CHAIN_ID = "bsync-valchange"
+
+
+def _build_chain_with_valset_change(n_blocks=10, promote_at=3):
+    pvs = [MockPV() for _ in range(3)]
+    new_pv = MockPV()
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+
+    def fresh(app):
+        state = make_genesis_state(gen)
+        conns = AppConns(local_client_creator(app))
+        conns.start()
+        mempool = CListMempool(make_test_config().mempool, conns.mempool)
+        ss, bs = StateStore(MemDB()), BlockStore(MemDB())
+        ss.save(state)
+        ex = BlockExecutor(ss, conns.consensus, mempool, None, bs)
+        return state, mempool, ss, bs, ex
+
+    state, mempool, ss, bs, ex = fresh(PersistentKVStoreApplication())
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    pv_by_addr[new_pv.address()] = new_pv
+    last_commit = Commit(height=0, round=0)
+    for h in range(1, n_blocks + 1):
+        if h == promote_at:
+            mempool.check_tx(
+                b"val:" + base64.b64encode(new_pv.get_pub_key().bytes()) + b"!15"
+            )
+        proposer = state.validators.get_proposer()
+        block = ex.create_proposal_block(h, state, last_commit, proposer.address)
+        parts = block.make_part_set()
+        bid = BlockID(block.hash(), parts.header())
+        sigs = []
+        for idx, val in enumerate(state.validators.validators):
+            vote = Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=block.header.time.add_nanos(10**9 * (idx + 1)),
+                validator_address=val.address, validator_index=idx,
+            )
+            sigs.append(
+                vote_to_commit_sig(pv_by_addr[val.address].sign_vote(CHAIN_ID, vote))
+            )
+        seen = Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        bs.save_block(block, parts, seen)
+        state, _ = ex.apply_block(state, bid, block)
+        last_commit = seen
+    assert state.validators.size() == 4, "promotion must have landed"
+    return gen, bs, new_pv
+
+
+def test_window_prefetch_survives_valset_change():
+    gen, server_store, new_pv = _build_chain_with_valset_change()
+    # fresh client with ITS OWN persistent app instance
+    state = make_genesis_state(gen)
+    conns = AppConns(local_client_creator(PersistentKVStoreApplication()))
+    conns.start()
+    mempool = CListMempool(make_test_config().mempool, conns.mempool)
+    ss, cs_bs = StateStore(MemDB()), BlockStore(MemDB())
+    ss.save(state)
+    ex = BlockExecutor(ss, conns.consensus, mempool, None, cs_bs)
+    reactor = BlocksyncReactor(
+        state=state, block_exec=ex, block_store=cs_bs, block_sync=True
+    )
+    for h in range(1, 11):
+        req = _Requester(h)
+        req.block = server_store.load_block(h)
+        req.peer_id = "p1"
+        reactor.pool._requesters[h] = req
+    applied = 0
+    while reactor._try_sync_one():
+        applied += 1
+    assert applied == 9, f"applied {applied}; the set change must not stall sync"
+    assert reactor.state.validators.size() == 4
+    assert reactor.state.validators.has_address(new_pv.address())
