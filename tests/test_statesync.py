@@ -309,3 +309,79 @@ def test_fresh_node_joins_live_net_via_statesync_through_node():
             node_c.stop()
         for n in nodes:
             n.stop()
+
+
+def test_statesync_failure_falls_back_to_blocksync():
+    """A misconfigured statesync (unreachable rpc_servers) must NOT leave a
+    zombie node: the boot phase falls back to blocksync-from-genesis and
+    the node still joins the live net (node/node.py _statesync_routine's
+    except branch)."""
+    from cometbft_tpu.abci.client import LocalClientCreator
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.types import cmttime
+
+    pvs = [MockPV() for _ in range(3)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=cmttime.now(),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+
+    def make_node(pv, broken_statesync=False):
+        cfg = make_test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus.timeout_commit = 0.2
+        cfg.consensus.skip_timeout_commit = False
+        if broken_statesync:
+            cfg.statesync.enable = True
+            # nothing listens here: provider construction/sync must fail fast
+            cfg.statesync.rpc_servers = ("http://127.0.0.1:1",)
+            cfg.statesync.trust_height = 1
+            cfg.statesync.trust_hash = "00" * 32
+            cfg.statesync.discovery_time = 0.3
+            cfg.statesync.chunk_request_timeout = 0.5
+        app = KVStoreApplication()
+        return Node(cfg, gen, pv, LocalClientCreator(app))
+
+    nodes = [make_node(pv) for pv in pvs]
+    joiner = None
+    try:
+        for n in nodes:
+            n.start()
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if j > i:
+                    n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        cs0 = nodes[0].consensus_state
+        deadline = time.time() + 60
+        while time.time() < deadline and cs0.rs.height < 4:
+            time.sleep(0.1)
+        assert cs0.rs.height >= 4
+
+        joiner = make_node(MockPV(), broken_statesync=True)
+        assert joiner._state_sync
+        joiner.start()
+        for m in nodes:
+            joiner.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
+        # despite broken statesync, the node must blocksync from genesis and
+        # reach (then follow) the tip
+        deadline = time.time() + 120
+        target = cs0.rs.height + 2
+        while time.time() < deadline:
+            rs = joiner.consensus_state.rs
+            if rs and rs.height > target:
+                break
+            time.sleep(0.2)
+        got = joiner.consensus_state.rs.height if joiner.consensus_state.rs else 0
+        assert got > target, f"fallback node stuck at {got} (target {target})"
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        for n in nodes:
+            n.stop()
