@@ -27,7 +27,15 @@ def hash_from_byte_slices(items: list[bytes]) -> bytes:
 
     Level-synchronous (iterative) so 64k+ leaf blocks don't hit Python
     recursion limits; identical output to the reference's recursive split.
+    Large trees take the native C path (SHA-NI when the host has it) —
+    bit-identical, cross-checked in tests/test_native.py.
     """
+    if len(items) >= 32:
+        from cometbft_tpu import native
+
+        if native.ready() is not None:
+            return native.merkle_root(items)
+        native.ensure_built_async()  # build off-thread; pure path meanwhile
     return hash_from_byte_slices_iterative(items)
 
 

@@ -1,0 +1,420 @@
+/* Native host-tier ed25519 batch verification.
+ *
+ * The reference's CPU batch path (crypto/ed25519/ed25519.go:196-228,
+ * curve25519-voi BatchVerifier) wins over per-signature verification by
+ * checking ONE random-linear-combination equation
+ *
+ *     [8]( [sum z_i s_i mod L] B  -  sum [z_i] R_i  -  sum [z_i h_i mod L] A_i ) == identity
+ *
+ * with 128-bit random z_i, evaluated as a single multi-scalar
+ * multiplication (Pippenger bucket method).  This file is the TPU-framework
+ * analog for hosts without a device: radix-51 field arithmetic, extended
+ * twisted-Edwards points, ZIP-215 decompression (non-canonical y accepted,
+ * x=0 with sign bit rejected — crypto/ed25519/ed25519.go:27-29 semantics,
+ * anchored by cometbft_tpu/crypto/ed25519_pure.py), and a variable-time MSM.
+ * Scalar arithmetic mod L (hashing, z*h products, the B coefficient) stays
+ * in Python, which also drives bisection on batch failure to recover the
+ * per-signature bitmap the BatchVerifier seam promises.
+ *
+ * Variable-time throughout: verification handles public data only.
+ */
+
+#include <stdint.h>
+#include <string.h>
+#include <stddef.h>
+
+typedef uint64_t u64;
+typedef __uint128_t u128;
+typedef uint8_t u8;
+
+#define MASK51 ((1ULL << 51) - 1)
+
+typedef struct { u64 v[5]; } fe;
+typedef struct { fe X, Y, Z, T; } ge; /* extended: x=X/Z y=Y/Z T=XY/Z */
+
+static const fe FE_ONE = {{1, 0, 0, 0, 0}};
+
+/* d = -121665/121666 mod p, radix-51 */
+static const fe FE_D = {{
+    929955233495203ULL, 466365720129213ULL, 1662059464998953ULL,
+    2033849074728123ULL, 1442794654840575ULL}};
+/* 2d mod p */
+static const fe FE_2D = {{
+    1859910466990425ULL, 932731440258426ULL, 1072319116312658ULL,
+    1815898335770999ULL, 633789495995903ULL}};
+/* sqrt(-1) mod p */
+static const fe FE_SQRTM1 = {{
+    1718705420411056ULL, 234908883556509ULL, 2233514472574048ULL,
+    2117202627021982ULL, 765476049583133ULL}};
+
+/* base point B, affine, radix-51 */
+static const fe FE_BX = {{
+    1738742601995546ULL, 1146398526822698ULL, 2070867633025821ULL,
+    562264141797630ULL, 587772402128613ULL}};
+static const fe FE_BY = {{
+    1801439850948184ULL, 1351079888211148ULL, 450359962737049ULL,
+    900719925474099ULL, 1801439850948198ULL}};
+
+static void fe_add(fe *h, const fe *f, const fe *g) {
+    for (int i = 0; i < 5; i++) h->v[i] = f->v[i] + g->v[i];
+}
+
+/* h = f + 4p - g: subtrahend limbs up to 2^53 stay positive.  Callers
+ * fe_carry the result before it feeds a multiplication (mul/sq need
+ * limbs < 2^53; an uncarried sub output can reach ~2^53.6). */
+static void fe_sub(fe *h, const fe *f, const fe *g) {
+    h->v[0] = f->v[0] + 0x1FFFFFFFFFFFB4ULL - g->v[0];
+    h->v[1] = f->v[1] + 0x1FFFFFFFFFFFFCULL - g->v[1];
+    h->v[2] = f->v[2] + 0x1FFFFFFFFFFFFCULL - g->v[2];
+    h->v[3] = f->v[3] + 0x1FFFFFFFFFFFFCULL - g->v[3];
+    h->v[4] = f->v[4] + 0x1FFFFFFFFFFFFCULL - g->v[4];
+}
+
+static void fe_carry(fe *h) {
+    u64 c;
+    c = h->v[0] >> 51; h->v[0] &= MASK51; h->v[1] += c;
+    c = h->v[1] >> 51; h->v[1] &= MASK51; h->v[2] += c;
+    c = h->v[2] >> 51; h->v[2] &= MASK51; h->v[3] += c;
+    c = h->v[3] >> 51; h->v[3] &= MASK51; h->v[4] += c;
+    c = h->v[4] >> 51; h->v[4] &= MASK51; h->v[0] += c * 19;
+    c = h->v[0] >> 51; h->v[0] &= MASK51; h->v[1] += c;
+}
+
+static void fe_mul(fe *h, const fe *f, const fe *g) {
+    u64 f0 = f->v[0], f1 = f->v[1], f2 = f->v[2], f3 = f->v[3], f4 = f->v[4];
+    u64 g0 = g->v[0], g1 = g->v[1], g2 = g->v[2], g3 = g->v[3], g4 = g->v[4];
+    u64 g1_19 = 19 * g1, g2_19 = 19 * g2, g3_19 = 19 * g3, g4_19 = 19 * g4;
+
+    u128 t0 = (u128)f0 * g0 + (u128)f1 * g4_19 + (u128)f2 * g3_19 +
+              (u128)f3 * g2_19 + (u128)f4 * g1_19;
+    u128 t1 = (u128)f0 * g1 + (u128)f1 * g0 + (u128)f2 * g4_19 +
+              (u128)f3 * g3_19 + (u128)f4 * g2_19;
+    u128 t2 = (u128)f0 * g2 + (u128)f1 * g1 + (u128)f2 * g0 +
+              (u128)f3 * g4_19 + (u128)f4 * g3_19;
+    u128 t3 = (u128)f0 * g3 + (u128)f1 * g2 + (u128)f2 * g1 +
+              (u128)f3 * g0 + (u128)f4 * g4_19;
+    u128 t4 = (u128)f0 * g4 + (u128)f1 * g3 + (u128)f2 * g2 +
+              (u128)f3 * g1 + (u128)f4 * g0;
+
+    u64 r0, r1, r2, r3, r4, c;
+    t1 += (u64)(t0 >> 51); r0 = (u64)t0 & MASK51;
+    t2 += (u64)(t1 >> 51); r1 = (u64)t1 & MASK51;
+    t3 += (u64)(t2 >> 51); r2 = (u64)t2 & MASK51;
+    t4 += (u64)(t3 >> 51); r3 = (u64)t3 & MASK51;
+    c = (u64)(t4 >> 51);   r4 = (u64)t4 & MASK51;
+    r0 += c * 19;
+    r1 += r0 >> 51; r0 &= MASK51;
+    h->v[0] = r0; h->v[1] = r1; h->v[2] = r2; h->v[3] = r3; h->v[4] = r4;
+}
+
+static void fe_sq(fe *h, const fe *f) {
+    u64 f0 = f->v[0], f1 = f->v[1], f2 = f->v[2], f3 = f->v[3], f4 = f->v[4];
+    u64 f0_2 = 2 * f0, f1_2 = 2 * f1;
+    u64 f3_19 = 19 * f3, f4_19 = 19 * f4;
+
+    u128 t0 = (u128)f0 * f0 + (u128)f1_2 * f4_19 + (u128)(2 * f2) * f3_19;
+    u128 t1 = (u128)f0_2 * f1 + (u128)f2 * f4_19 * 2 + (u128)f3 * f3_19;
+    u128 t2 = (u128)f0_2 * f2 + (u128)f1 * f1 + (u128)(2 * f3) * f4_19;
+    u128 t3 = (u128)f0_2 * f3 + (u128)f1_2 * f2 + (u128)f4 * f4_19;
+    u128 t4 = (u128)f0_2 * f4 + (u128)f1_2 * f3 + (u128)f2 * f2;
+
+    u64 r0, r1, r2, r3, r4, c;
+    t1 += (u64)(t0 >> 51); r0 = (u64)t0 & MASK51;
+    t2 += (u64)(t1 >> 51); r1 = (u64)t1 & MASK51;
+    t3 += (u64)(t2 >> 51); r2 = (u64)t2 & MASK51;
+    t4 += (u64)(t3 >> 51); r3 = (u64)t3 & MASK51;
+    c = (u64)(t4 >> 51);   r4 = (u64)t4 & MASK51;
+    r0 += c * 19;
+    r1 += r0 >> 51; r0 &= MASK51;
+    h->v[0] = r0; h->v[1] = r1; h->v[2] = r2; h->v[3] = r3; h->v[4] = r4;
+}
+
+/* ignores bit 255 (sign bit handled by the caller); value may be >= p
+ * (ZIP-215 rule 1: non-canonical y is reduced, not rejected) */
+static void fe_frombytes(fe *h, const u8 s[32]) {
+    u64 w0, w1, w2, w3;
+    memcpy(&w0, s, 8); memcpy(&w1, s + 8, 8);
+    memcpy(&w2, s + 16, 8); memcpy(&w3, s + 24, 8);
+    h->v[0] = w0 & MASK51;
+    h->v[1] = ((w0 >> 51) | (w1 << 13)) & MASK51;
+    h->v[2] = ((w1 >> 38) | (w2 << 26)) & MASK51;
+    h->v[3] = ((w2 >> 25) | (w3 << 39)) & MASK51;
+    h->v[4] = (w3 >> 12) & MASK51; /* drops bit 255 (the sign bit) */
+}
+
+/* canonical little-endian encoding (full reduction mod p, top bit clear) */
+static void fe_tobytes(u8 s[32], const fe *f) {
+    fe t = *f;
+    fe_carry(&t);
+    fe_carry(&t);
+    /* limbs now < 2^51; conditionally subtract p */
+    u64 q = (t.v[0] + 19) >> 51;
+    q = (t.v[1] + q) >> 51;
+    q = (t.v[2] + q) >> 51;
+    q = (t.v[3] + q) >> 51;
+    q = (t.v[4] + q) >> 51;
+    t.v[0] += 19 * q;
+    u64 c;
+    c = t.v[0] >> 51; t.v[0] &= MASK51; t.v[1] += c;
+    c = t.v[1] >> 51; t.v[1] &= MASK51; t.v[2] += c;
+    c = t.v[2] >> 51; t.v[2] &= MASK51; t.v[3] += c;
+    c = t.v[3] >> 51; t.v[3] &= MASK51; t.v[4] += c;
+    t.v[4] &= MASK51;
+    u64 w0 = t.v[0] | (t.v[1] << 51);
+    u64 w1 = (t.v[1] >> 13) | (t.v[2] << 38);
+    u64 w2 = (t.v[2] >> 26) | (t.v[3] << 25);
+    u64 w3 = (t.v[3] >> 39) | (t.v[4] << 12);
+    memcpy(s, &w0, 8); memcpy(s + 8, &w1, 8);
+    memcpy(s + 16, &w2, 8); memcpy(s + 24, &w3, 8);
+}
+
+static int fe_iszero(const fe *f) {
+    u8 s[32];
+    fe_tobytes(s, f);
+    u8 acc = 0;
+    for (int i = 0; i < 32; i++) acc |= s[i];
+    return acc == 0;
+}
+
+static int fe_eq(const fe *f, const fe *g) {
+    fe t;
+    fe_sub(&t, f, g);
+    return fe_iszero(&t);
+}
+
+static int fe_isodd(const fe *f) {
+    u8 s[32];
+    fe_tobytes(s, f);
+    return s[0] & 1;
+}
+
+static void fe_neg(fe *h, const fe *f) {
+    fe zero = {{0, 0, 0, 0, 0}};
+    fe_sub(h, &zero, f);
+    fe_carry(h);
+}
+
+/* f^(2^252 - 3)  ==  f^((p-5)/8): binary chain over 2^250-1 */
+static void fe_pow2523(fe *out, const fe *z) {
+    fe t0, t1, t2;
+    int i;
+    fe_sq(&t0, z);                                   /* 2 */
+    fe_sq(&t1, &t0); fe_sq(&t1, &t1);                /* 8 */
+    fe_mul(&t1, z, &t1);                             /* 9 */
+    fe_mul(&t0, &t0, &t1);                           /* 11 */
+    fe_sq(&t0, &t0);                                 /* 22 */
+    fe_mul(&t0, &t1, &t0);                           /* 2^5-1 */
+    fe_sq(&t1, &t0);
+    for (i = 1; i < 5; i++) fe_sq(&t1, &t1);
+    fe_mul(&t0, &t1, &t0);                           /* 2^10-1 */
+    fe_sq(&t1, &t0);
+    for (i = 1; i < 10; i++) fe_sq(&t1, &t1);
+    fe_mul(&t1, &t1, &t0);                           /* 2^20-1 */
+    fe_sq(&t2, &t1);
+    for (i = 1; i < 20; i++) fe_sq(&t2, &t2);
+    fe_mul(&t1, &t2, &t1);                           /* 2^40-1 */
+    fe_sq(&t1, &t1);
+    for (i = 1; i < 10; i++) fe_sq(&t1, &t1);
+    fe_mul(&t0, &t1, &t0);                           /* 2^50-1 */
+    fe_sq(&t1, &t0);
+    for (i = 1; i < 50; i++) fe_sq(&t1, &t1);
+    fe_mul(&t1, &t1, &t0);                           /* 2^100-1 */
+    fe_sq(&t2, &t1);
+    for (i = 1; i < 100; i++) fe_sq(&t2, &t2);
+    fe_mul(&t1, &t2, &t1);                           /* 2^200-1 */
+    fe_sq(&t1, &t1);
+    for (i = 1; i < 50; i++) fe_sq(&t1, &t1);
+    fe_mul(&t0, &t1, &t0);                           /* 2^250-1 */
+    fe_sq(&t0, &t0); fe_sq(&t0, &t0);                /* 2^252-4 */
+    fe_mul(out, &t0, z);                             /* 2^252-3 */
+}
+
+static const ge GE_ID = {{{0,0,0,0,0}}, {{1,0,0,0,0}}, {{1,0,0,0,0}}, {{0,0,0,0,0}}};
+
+/* unified add-2008-hwcd-3 for a=-1: complete for all curve points
+ * (including small-order), so bucket accumulation needs no special cases */
+static void ge_add(ge *r, const ge *p, const ge *q) {
+    fe A, B, C, D, E, F, G, H, t1, t2;
+    fe_sub(&t1, &p->Y, &p->X);
+    fe_sub(&t2, &q->Y, &q->X);
+    fe_carry(&t1); fe_carry(&t2);
+    fe_mul(&A, &t1, &t2);
+    fe_add(&t1, &p->Y, &p->X);
+    fe_add(&t2, &q->Y, &q->X);
+    fe_mul(&B, &t1, &t2);
+    fe_mul(&C, &p->T, &q->T);
+    fe_mul(&C, &C, &FE_2D);
+    fe_mul(&D, &p->Z, &q->Z);
+    fe_add(&D, &D, &D);
+    fe_sub(&E, &B, &A); fe_carry(&E);
+    fe_sub(&F, &D, &C); fe_carry(&F);
+    fe_add(&G, &D, &C);
+    fe_add(&H, &B, &A);
+    fe_mul(&r->X, &E, &F);
+    fe_mul(&r->Y, &G, &H);
+    fe_mul(&r->Z, &F, &G);
+    fe_mul(&r->T, &E, &H);
+}
+
+/* dedicated doubling (dbl-2008-hwcd), 4M+4S */
+static void ge_dbl(ge *r, const ge *p) {
+    fe A, B, C, D, E, F, G, H, t;
+    fe_sq(&A, &p->X);
+    fe_sq(&B, &p->Y);
+    fe_sq(&C, &p->Z);
+    fe_add(&C, &C, &C);
+    fe_neg(&D, &A);
+    fe_add(&t, &p->X, &p->Y); fe_carry(&t);
+    fe_sq(&t, &t);
+    fe_sub(&t, &t, &A); fe_sub(&t, &t, &B); fe_carry(&t);
+    E = t;
+    fe_add(&G, &D, &B);
+    fe_sub(&F, &G, &C); fe_carry(&F);
+    fe_sub(&H, &D, &B); fe_carry(&H);
+    fe_mul(&r->X, &E, &F);
+    fe_mul(&r->Y, &G, &H);
+    fe_mul(&r->Z, &F, &G);
+    fe_mul(&r->T, &E, &H);
+}
+
+static void ge_neg(ge *r, const ge *p) {
+    fe_neg(&r->X, &p->X);
+    r->Y = p->Y;
+    r->Z = p->Z;
+    fe_neg(&r->T, &p->T);
+}
+
+/* ZIP-215 decompression: returns 1 on success */
+static int ge_frombytes_zip215(ge *h, const u8 s[32]) {
+    fe u, v, v3, vxx, check, x, y;
+    int sign = s[31] >> 7;
+    fe_frombytes(&y, s);
+    fe_sq(&u, &y);
+    fe_mul(&v, &u, &FE_D);
+    fe_sub(&u, &u, &FE_ONE); fe_carry(&u);       /* u = y^2 - 1 */
+    fe_add(&v, &v, &FE_ONE);                      /* v = d y^2 + 1 */
+
+    fe_sq(&v3, &v);
+    fe_mul(&v3, &v3, &v);                         /* v^3 */
+    fe_sq(&x, &v3);
+    fe_mul(&x, &x, &v);
+    fe_mul(&x, &x, &u);                           /* u v^7 */
+    fe_pow2523(&x, &x);                           /* (u v^7)^((p-5)/8) */
+    fe_mul(&x, &x, &v3);
+    fe_mul(&x, &x, &u);                           /* u v^3 (u v^7)^((p-5)/8) */
+
+    fe_sq(&vxx, &x);
+    fe_mul(&vxx, &vxx, &v);
+    fe_sub(&check, &vxx, &u);
+    if (!fe_iszero(&check)) {
+        fe_add(&check, &vxx, &u);
+        if (!fe_iszero(&check)) return 0;
+        fe_mul(&x, &x, &FE_SQRTM1);
+    }
+    if (fe_iszero(&x)) {
+        if (sign) return 0;                       /* x=0 with sign bit set */
+    } else if (fe_isodd(&x) != sign) {
+        fe_neg(&x, &x);
+    }
+    h->X = x;
+    h->Y = y;
+    h->Z = FE_ONE;
+    fe_mul(&h->T, &x, &y);
+    return 1;
+}
+
+static int ge_is_identity(const ge *p) {
+    return fe_iszero(&p->X) && fe_eq(&p->Y, &p->Z);
+}
+
+/* ---- exported API (ctypes) ---- */
+
+/* Decompress pubkeys and R components, negated, for the batch equation.
+ * pubs: n*32, sigs: n*64 (R||s).  Aneg/Rneg: n ge slots (opaque to Python).
+ * ok[i] = 1 if both decompressed (s-range is checked Python-side).
+ * Returns the number of ok entries. */
+long cmtpu_ed25519_precheck(long n, const u8 *pubs, const u8 *sigs,
+                            ge *Aneg, ge *Rneg, u8 *ok) {
+    long good = 0;
+    for (long i = 0; i < n; i++) {
+        ge A, R;
+        if (ge_frombytes_zip215(&A, pubs + 32 * i) &&
+            ge_frombytes_zip215(&R, sigs + 64 * i)) {
+            ge_neg(&Aneg[i], &A);
+            ge_neg(&Rneg[i], &R);
+            ok[i] = 1;
+            good++;
+        } else {
+            ok[i] = 0;
+        }
+    }
+    return good;
+}
+
+static int pick_window(long npoints) {
+    if (npoints < 32) return 4;
+    if (npoints < 128) return 5;
+    if (npoints < 512) return 7;
+    if (npoints < 2048) return 9;
+    if (npoints < 8192) return 10;
+    if (npoints < 32768) return 11;
+    return 12;
+}
+
+static int get_digit(const u8 *sc, int pos, int c) {
+    int byte = pos >> 3, shift = pos & 7;
+    uint32_t v = sc[byte];
+    if (byte + 1 < 32) v |= (uint32_t)sc[byte + 1] << 8;
+    if (byte + 2 < 32) v |= (uint32_t)sc[byte + 2] << 16;
+    return (v >> shift) & ((1 << c) - 1);
+}
+
+static ge BUCKETS[1 << 12];
+
+/* Check  [8]( [ssum]B + sum [z_i]Rneg_i + sum [zh_i]Aneg_i ) == identity
+ * over the m-entry subset idx of the prechecked points.
+ * ssum: 32 bytes; z,zh: n*32 bytes (indexed by idx).  Returns 1 if holds. */
+int cmtpu_ed25519_check_subset(const ge *Aneg, const ge *Rneg,
+                               const int64_t *idx, long m,
+                               const u8 *ssum, const u8 *z, const u8 *zh) {
+    long npoints = 2 * m + 1;
+    int c = pick_window(npoints);
+    int nbuckets = (1 << c) - 1;
+    int nwin = (253 + c - 1) / c;
+    ge acc = GE_ID, Bp;
+    Bp.X = FE_BX; Bp.Y = FE_BY; Bp.Z = FE_ONE;
+    fe_mul(&Bp.T, &FE_BX, &FE_BY);
+
+    for (int w = nwin - 1; w >= 0; w--) {
+        if (w != nwin - 1)
+            for (int k = 0; k < c; k++) ge_dbl(&acc, &acc);
+        int pos = w * c;
+        for (int b = 0; b < nbuckets; b++) BUCKETS[b] = GE_ID;
+        int d = get_digit(ssum, pos, c);
+        int used = 0;
+        if (d) {
+            ge_add(&BUCKETS[d - 1], &BUCKETS[d - 1], &Bp);
+            used = 1;
+        }
+        for (long j = 0; j < m; j++) {
+            long i = idx[j];
+            d = get_digit(z + 32 * i, pos, c);
+            if (d) { ge_add(&BUCKETS[d - 1], &BUCKETS[d - 1], &Rneg[i]); used = 1; }
+            d = get_digit(zh + 32 * i, pos, c);
+            if (d) { ge_add(&BUCKETS[d - 1], &BUCKETS[d - 1], &Aneg[i]); used = 1; }
+        }
+        if (!used) continue;
+        ge run = GE_ID, wsum = GE_ID;
+        for (int b = nbuckets - 1; b >= 0; b--) {
+            ge_add(&run, &run, &BUCKETS[b]);
+            ge_add(&wsum, &wsum, &run);
+        }
+        ge_add(&acc, &acc, &wsum);
+    }
+    ge_dbl(&acc, &acc);
+    ge_dbl(&acc, &acc);
+    ge_dbl(&acc, &acc);
+    return ge_is_identity(&acc);
+}
+
+long cmtpu_ge_size(void) { return (long)sizeof(ge); }

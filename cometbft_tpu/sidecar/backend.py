@@ -31,13 +31,34 @@ class VerifyBackend:
         raise NotImplementedError
 
 
+# Below this, per-signature OpenSSL verification beats the MSM's fixed
+# costs (two decompressions per signature, window bookkeeping).
+_NATIVE_BATCH_MIN = 16
+
+
 class CpuBackend(VerifyBackend):
-    """Host-tier fallback: per-signature verification, preserving the
-    (ok, per-sig bitmap) contract."""
+    """Host tier: the native C batch verifier (random-linear-combination
+    equation over one Pippenger MSM — the same construction as the
+    reference's curve25519-voi batch path, crypto/ed25519/ed25519.go:196)
+    when the extension is built, per-signature OpenSSL otherwise.  Both
+    preserve the (ok, per-sig bitmap) contract with ZIP-215 semantics."""
 
     name = "cpu"
 
+    def __init__(self):
+        from cometbft_tpu import native
+
+        # Start the (possibly multi-second) gcc build off-thread now so the
+        # first commit verification never stalls behind it; until it lands,
+        # batch_verify falls through to per-signature OpenSSL.
+        native.ensure_built_async()
+
     def batch_verify(self, pubs, msgs, sigs):
+        if len(pubs) >= _NATIVE_BATCH_MIN:
+            from cometbft_tpu import native
+
+            if native.ready() is not None:
+                return native.batch_verify(pubs, msgs, sigs)
         from cometbft_tpu.crypto import ed25519
 
         results = [
