@@ -176,6 +176,12 @@ class BlocksyncReactor(Reactor):
     # bucket; the verified-triple cache then makes both the trySync
     # VerifyCommitLight AND ApplyBlock's full LastCommit check cache hits.
     PREFETCH_WINDOW = 32
+    # Signature budget for one prefetch dispatch: stay within the largest
+    # precompiled device bucket AND well under the verified-triple cache
+    # (ed25519._VERIFIED_MAX = 131072), else a large validator set makes the
+    # window force a one-off oversized XLA compile and evict its own cache
+    # entries before trySync consumes them.
+    PREFETCH_MAX_SIGS = 32768
 
     def _prefetch_verify_window(self) -> None:
         """TPU-first fast sync: while validator sets are unchanged
@@ -189,10 +195,20 @@ class BlocksyncReactor(Reactor):
 
         if self.pool.height < self._prefetched_to:
             return
-        window = self.pool.peek_window(self.PREFETCH_WINDOW)
+        vals = self.state.validators
+        # Clamp the window in SIGNATURES, not blocks (a 10k-validator set
+        # at 32 blocks would be ~320k triples in one dispatch).  Below 3
+        # blocks there is nothing to batch (window covers window-1 commits);
+        # skip before paying the pool-mutex peek.
+        window_blocks = min(
+            self.PREFETCH_WINDOW,
+            self.PREFETCH_MAX_SIGS // max(1, len(vals.validators)),
+        )
+        if window_blocks < 3:
+            return
+        window = self.pool.peek_window(window_blocks)
         if len(window) < 3:
             return
-        vals = self.state.validators
         # Only ed25519 carries the verified-triple cache; for other key
         # types a prefetch would be pure extra work (three verifications
         # per commit instead of two).

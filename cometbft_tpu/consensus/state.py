@@ -107,6 +107,9 @@ class ConsensusState:
 
         self.rs = RoundState()
         self.state = None  # sm.State, set in update_to_state
+        # Known-bad (pub, sig, signbytes) triples seen by the prebatcher —
+        # see _prebatch_vote_signatures.
+        self._failed_triples: dict[bytes, None] = {}
         self.priv_validator = None
         self.priv_validator_pub_key = None
         self.replay_mode = False
@@ -319,11 +322,19 @@ class ConsensusState:
                     else:
                         traceback.print_exc()
 
+    # Bound on the known-bad-triple memo (below): enough for a sustained
+    # invalid-vote storm without growing unboundedly.
+    _FAILED_TRIPLES_MAX = 4096
+
     def _prebatch_vote_signatures(self, items) -> None:
         """Batch-verify the signatures of queued peer votes (crypto only —
         every protocol check still runs in _try_add_vote; invalid sigs are
         simply not cached and fail there as before). A pure optimization:
-        errors here must never disturb the state machine."""
+        errors here must never disturb the state machine.
+
+        Triples that already failed a batch are memoized and skipped, so an
+        attacker replaying invalid signatures costs one device dispatch and
+        one host verify per UNIQUE bad triple, not one of each per drain."""
         try:
             from cometbft_tpu.crypto import ed25519 as _ed
 
@@ -335,6 +346,7 @@ class ConsensusState:
                 return
             vals = self.state.validators
             bv = _ed.BatchVerifier()
+            keys = []
             for v in votes:
                 if not (0 <= v.validator_index < vals.size()):
                     continue
@@ -345,9 +357,19 @@ class ConsensusState:
                     continue
                 if len(v.signature) != _ed.SIGNATURE_SIZE:
                     continue
-                bv.add(val.pub_key, v.sign_bytes(self.state.chain_id), v.signature)
+                sb = v.sign_bytes(self.state.chain_id)
+                key = val.pub_key.bytes() + v.signature + sb
+                if key in self._failed_triples:
+                    continue
+                bv.add(val.pub_key, sb, v.signature)
+                keys.append(key)
             if len(bv) >= 8:
-                bv.verify()
+                _, bits = bv.verify()
+                for key, valid in zip(keys, bits):
+                    if not valid:
+                        if len(self._failed_triples) >= self._FAILED_TRIPLES_MAX:
+                            self._failed_triples.clear()
+                        self._failed_triples[key] = None
         except Exception:
             pass
 

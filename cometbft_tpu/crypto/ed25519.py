@@ -153,14 +153,14 @@ _verified_lock = threading.Lock()
 
 def _verified_put(key: bytes) -> None:
     # Writers race from multiple threads (blocksync pool routine, consensus,
-    # light client): eviction takes the lock, and pop() tolerates a key a
-    # concurrent evictor already removed.
-    if len(_verified) >= _VERIFIED_MAX:
-        with _verified_lock:
-            if len(_verified) >= _VERIFIED_MAX:
-                for k in list(_verified)[: _VERIFIED_MAX // 4]:
-                    _verified.pop(k, None)
-    _verified[key] = None
+    # light client).  The insertion happens under the same lock as eviction:
+    # list(dict) while another thread inserts is only safe via the CPython
+    # GIL, and the lock is nothing next to a signature verify.
+    with _verified_lock:
+        if len(_verified) >= _VERIFIED_MAX:
+            for k in list(_verified)[: _VERIFIED_MAX // 4]:
+                _verified.pop(k, None)
+        _verified[key] = None
 
 
 class BatchVerifier(crypto.BatchVerifier):

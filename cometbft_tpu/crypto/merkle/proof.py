@@ -7,6 +7,7 @@ bounds proof size against DoS (proof.go:12-16).
 
 from __future__ import annotations
 
+from collections.abc import Sequence
 from dataclasses import dataclass, field
 
 from cometbft_tpu.crypto import tmhash
@@ -117,7 +118,52 @@ def compute_hash_from_aunts(
     return h
 
 
-def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+class _LazyProofs(Sequence):
+    """Sequence of Proof over the native packed-aunts buffer.
+
+    All hashing (every tree level) and aunt gathering already happened in
+    one C pass; this materializes the per-leaf Proof object — 32-byte aunt
+    slices included — only when indexed, because a 64k-leaf block would
+    otherwise allocate ~1M small bytes objects up front that consumers
+    (tx proof RPC, part-set gossip) touch one leaf at a time.
+    """
+
+    __slots__ = ("_n", "_leaf_hashes", "_packed", "_stride", "_counts")
+
+    def __init__(self, n, leaf_hashes, packed, stride, counts):
+        self._n = n
+        self._leaf_hashes = leaf_hashes
+        self._packed = packed
+        self._stride = stride
+        self._counts = counts
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> Proof:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        base = i * self._stride
+        return Proof(
+            total=self._n,
+            index=i,
+            leaf_hash=self._leaf_hashes[i],
+            aunts=[
+                self._packed[base + 32 * k : base + 32 * (k + 1)]
+                for k in range(self._counts[i])
+            ],
+        )
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield self[i]
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, Sequence[Proof]]:
     """Root + one inclusion proof per item (crypto/merkle/proof.go:35-49).
 
     Level-synchronous construction: at each level node i's aunt is its
@@ -129,6 +175,15 @@ def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
         from cometbft_tpu.crypto.merkle.hash import empty_hash
 
         return empty_hash(), []
+    if n >= 32:
+        from cometbft_tpu import native
+
+        if native.ready() is not None:
+            root, leaf_hashes, packed, stride, counts = (
+                native.merkle_proof_parts(items)
+            )
+            return root, _LazyProofs(n, leaf_hashes, packed, stride, counts)
+        native.ensure_built_async()
     level = [leaf_hash(item) for item in items]
     leaf_hashes = list(level)
     aunts_per_leaf: list[list[bytes]] = [[] for _ in range(n)]

@@ -97,6 +97,16 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_long, ctypes.c_char_p, ctypes.c_void_p,
                     ctypes.c_void_p,
                 ]
+                lib.cmtpu_merkle_levels.restype = None
+                lib.cmtpu_merkle_levels.argtypes = [
+                    ctypes.c_long, ctypes.c_char_p, ctypes.c_void_p,
+                    ctypes.c_void_p,
+                ]
+                lib.cmtpu_merkle_aunts.restype = None
+                lib.cmtpu_merkle_aunts.argtypes = [
+                    ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
+                    ctypes.c_void_p, ctypes.c_void_p,
+                ]
                 _lib = lib
             except OSError:
                 _lib = None
@@ -241,6 +251,48 @@ def merkle_root(leaves: list[bytes]) -> bytes:
     out = ctypes.create_string_buffer(32)
     lib.cmtpu_merkle_root(n, buf, offs, scratch, out)
     return out.raw
+
+
+def merkle_proof_parts(
+    leaves: list[bytes],
+) -> tuple[bytes, list[bytes], bytes, int, "list[int]"]:
+    """Everything proofs_from_byte_slices needs, hashed in one C pass:
+    (root, leaf_hashes, packed_aunts, stride, counts) where leaf i's aunts
+    are packed_aunts[i*stride : i*stride + 32*counts[i]] in 32-byte nodes,
+    ordered sibling-first (crypto/merkle/proof.go:35-49 shape)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(leaves)
+    if n == 0:
+        return hashlib.sha256(b"").digest(), [], b"", 0, []
+    buf = b"".join(leaves)
+    offs = (ctypes.c_uint64 * (n + 1))()
+    acc = 0
+    for i, leaf in enumerate(leaves):
+        offs[i] = acc
+        acc += len(leaf)
+    offs[n] = acc
+
+    total_nodes = 0
+    size = n
+    depth = 0
+    while True:
+        total_nodes += size
+        if size == 1:
+            break
+        size = (size + 1) // 2
+        depth += 1
+    levels = ctypes.create_string_buffer(32 * total_nodes)
+    lib.cmtpu_merkle_levels(n, buf, offs, levels)
+    lraw = levels.raw  # one copy out of ctypes; .raw re-copies per access
+    root = lraw[32 * (total_nodes - 1) : 32 * total_nodes]
+    leaf_hashes = [lraw[32 * i : 32 * i + 32] for i in range(n)]
+    stride = 32 * max(depth, 1)
+    aunts = ctypes.create_string_buffer(n * stride)
+    counts = (ctypes.c_int32 * n)()
+    lib.cmtpu_merkle_aunts(n, levels, max(depth, 1), aunts, counts)
+    return root, leaf_hashes, aunts.raw, stride, list(counts)
 
 
 def sha256_batch(msgs: list[bytes]) -> list[bytes]:

@@ -239,3 +239,86 @@ void cmtpu_sha256_batch(long n, const u8 *buf, const u64 *offs, u8 *out) {
     for (long i = 0; i < n; i++)
         sha256(buf + offs[i], offs[i + 1] - offs[i], out + 32 * i);
 }
+
+/* Inclusion-proof support (crypto/merkle/proof.go:35-49): build every tree
+ * level into `levels` (leaf level first; each level of size s followed by
+ * one of size (s+1)/2, odd node copied up), then gather each leaf's aunts
+ * bottom-up.  aunts: stride 32*max_depth bytes per leaf; counts[i] = number
+ * of aunts for leaf i (a promoted odd node contributes none at that level).
+ * Caller sizes `levels` to 32 * (sum of all level sizes). */
+void cmtpu_merkle_levels(long n, const u8 *buf, const u64 *offs, u8 *levels) {
+    u8 tmp[1 + 64];
+    u8 *cur = levels;
+    for (long i = 0; i < n; i++) {
+        u64 len = offs[i + 1] - offs[i];
+        if (len <= 64) {
+            tmp[0] = 0x00;
+            memcpy(tmp + 1, buf + offs[i], len);
+            sha256(tmp, len + 1, cur + 32 * i);
+        } else {
+            u8 big[1 + 4096];
+            if (len <= 4096) {
+                big[0] = 0x00;
+                memcpy(big + 1, buf + offs[i], len);
+                sha256(big, len + 1, cur + 32 * i);
+            } else {
+                /* fall back: leaf-hash via the scratch streaming path in
+                 * cmtpu_merkle_root's shape; leaves this large do not occur
+                 * in block data (txs are size-bounded), keep it simple */
+                u64 one_off[2] = {0, len};
+                u8 unused_scratch[32];
+                (void)unused_scratch;
+                cmtpu_merkle_root(1, buf + offs[i], one_off, cur + 32 * i,
+                                  cur + 32 * i);
+            }
+        }
+    }
+    long size = n;
+    u8 inner[65];
+    inner[0] = 0x01;
+    while (size > 1) {
+        u8 *nxt = cur + 32 * size;
+        long out_i = 0;
+        for (long i = 0; i + 1 < size; i += 2) {
+            memcpy(inner + 1, cur + 32 * i, 32);
+            memcpy(inner + 33, cur + 32 * (i + 1), 32);
+            sha256(inner, 65, nxt + 32 * out_i);
+            out_i++;
+        }
+        if (size & 1) {
+            memcpy(nxt + 32 * out_i, cur + 32 * (size - 1), 32);
+            out_i++;
+        }
+        cur = nxt;
+        size = out_i;
+    }
+}
+
+void cmtpu_merkle_aunts(long n, const u8 *levels, long max_depth, u8 *aunts,
+                        int32_t *counts) {
+    /* level start offsets (in nodes) */
+    long starts[64], sizes[64], nlevels = 0;
+    long size = n, acc = 0;
+    while (1) {
+        starts[nlevels] = acc;
+        sizes[nlevels] = size;
+        acc += size;
+        nlevels++;
+        if (size == 1) break;
+        size = (size + 1) / 2;
+    }
+    for (long i = 0; i < n; i++) {
+        long idx = i, cnt = 0;
+        u8 *dst = aunts + (u64)i * 32 * max_depth;
+        for (long l = 0; l + 1 < nlevels; l++) {
+            long sib = idx ^ 1;
+            if (sib < sizes[l]) {
+                memcpy(dst + 32 * cnt,
+                       levels + 32 * (starts[l] + sib), 32);
+                cnt++;
+            }
+            idx >>= 1;
+        }
+        counts[i] = (int32_t)cnt;
+    }
+}

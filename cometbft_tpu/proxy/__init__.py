@@ -24,6 +24,16 @@ class AppConns:
         self.consensus = self._creator.new_abci_client()
 
     def stop(self) -> None:
+        # multi_app_conn.OnStop: each connection owns a socket + reader
+        # thread (socket/gRPC transports) that must be torn down, not
+        # dropped — dropping leaks the thread and the app-side connection.
+        for client in (self.consensus, self.mempool, self.query, self.snapshot):
+            close = getattr(client, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
         self.consensus = self.mempool = self.query = self.snapshot = None
 
 

@@ -201,10 +201,15 @@ class StateStore:
                     self._db.delete(k)
                 elif h > retain_height and prefix != b"abciResponsesKey:":
                     # Retained pointer records that referenced a deleted
-                    # checkpoint now chase the migrated one. (Proposer-
-                    # priority restoration composes: incrementing from the
-                    # migrated checkpoint by h - retain equals incrementing
-                    # from the original by h - last_changed.)
+                    # checkpoint now chase the migrated one.  NOTE: proposer-
+                    # priority restoration after this rewrite is order-
+                    # preserving but not always bit-exact — each increment
+                    # re-applies rescale+shift, which composes exactly only
+                    # while rescaling never clips.  Safe for consensus
+                    # (priorities are excluded from validator hashes, and
+                    # the live proposer comes from the state record, not
+                    # historical loads); only historical
+                    # load_validators().proposer can diverge post-prune.
                     try:
                         info = json.loads(raw)
                     except ValueError:

@@ -55,6 +55,10 @@ class ValidatorSet:
         self.validators: list[Validator] = []
         self.proposer: Validator | None = None
         self._total_voting_power = 0
+        # address -> index, built lazily: commit verification looks every
+        # signature's validator up by address, which is O(n^2) per commit as
+        # a linear scan at 4k+ validators.  Invalidated on membership change.
+        self._addr_index: dict[bytes, int] | None = None
         if validators:
             err = self._update_with_change_set(
                 [v.copy() for v in validators], allow_deletes=False
@@ -71,14 +75,21 @@ class ValidatorSet:
     def size(self) -> int:
         return len(self.validators)
 
+    def _index(self) -> dict[bytes, int]:
+        if self._addr_index is None:
+            self._addr_index = {
+                v.address: i for i, v in enumerate(self.validators)
+            }
+        return self._addr_index
+
     def has_address(self, address: bytes) -> bool:
-        return any(v.address == address for v in self.validators)
+        return address in self._index()
 
     def get_by_address(self, address: bytes):
-        for i, v in enumerate(self.validators):
-            if v.address == address:
-                return i, v.copy()
-        return -1, None
+        i = self._index().get(address, -1)
+        if i < 0:
+            return -1, None
+        return i, self.validators[i].copy()
 
     def get_by_index(self, index: int):
         if index < 0 or index >= len(self.validators):
@@ -275,6 +286,7 @@ class ValidatorSet:
         self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
         self._shift_by_avg_proposer_priority()
         self.validators.sort(key=_by_voting_power_key)
+        self._addr_index = None
         return None
 
     def _apply_updates(self, updates: list[Validator]) -> None:
@@ -293,12 +305,14 @@ class ValidatorSet:
         merged.extend(existing[i:])
         merged.extend(updates[j:])
         self.validators = merged
+        self._addr_index = None
 
     def _apply_removals(self, deletes: list[Validator]) -> None:
         if not deletes:
             return
         dset = {d.address for d in deletes}
         self.validators = [v for v in self.validators if v.address not in dset]
+        self._addr_index = None
 
     # -- verification wrappers (validator_set.go:662-680) --------------------
 

@@ -268,3 +268,36 @@ def test_abci_cli_batch_commands(kvstore_proc, capsys):
     assert "0x" in out  # commit app hash
     assert "value: 0x" + b"works".hex().upper() in out
     assert cli_main(["--addr", kvstore_proc, "bogus"]) == 1
+
+
+def test_app_conns_stop_closes_clients(tmp_path):
+    """proxy.AppConns.stop() must close every connection (reference
+    multi_app_conn OnStop): no leaked reader threads or sockets after."""
+    import threading
+
+    from cometbft_tpu.proxy import new_app_conns
+
+    srv = ABCIServer(KVStoreApplication(), f"unix://{tmp_path}/conns.sock")
+    bound = srv.start()
+    try:
+        conns = new_app_conns(SocketClientCreator(bound))
+        before = set(threading.enumerate())
+        conns.start()
+        assert conns.consensus.echo("x").message == "x"
+        assert conns.mempool.check_tx(abci.RequestCheckTx(tx=b"k=v")).is_ok()
+        started = [t for t in set(threading.enumerate()) - before if t.is_alive()]
+        assert started, "socket clients should have spawned reader threads"
+        sockets = [
+            c._sock
+            for c in (conns.consensus, conns.mempool, conns.query, conns.snapshot)
+        ]
+        conns.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and any(t.is_alive() for t in started):
+            time.sleep(0.02)
+        leaked = [t.name for t in started if t.is_alive()]
+        assert not leaked, f"leaked threads after AppConns.stop(): {leaked}"
+        assert all(s.fileno() == -1 for s in sockets), "socket not closed"
+        assert conns.consensus is None and conns.snapshot is None
+    finally:
+        srv.stop()

@@ -125,6 +125,7 @@ def test_consensus_prebatch_warms_cache(counting_backend):
     cs = FakeCS()
     cs.state = state
     cs.logger = None
+    cs._failed_triples = {}
     from cometbft_tpu.consensus.state import ConsensusState
 
     bid = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x07" * 32))
@@ -197,3 +198,59 @@ def test_blocksync_prefetch_batches_window(counting_backend):
         f"{counting_backend.calls} backend calls for {applied} blocks "
         f"({counting_backend.sigs} sigs)"
     )
+
+
+def test_prebatch_memoizes_failed_triples(counting_backend):
+    """An invalid-vote storm replayed across drains costs ONE dispatch for
+    the unique bad triples, not one per drain (advisor r4: attacker-
+    controlled double-verification amplification)."""
+    from cometbft_tpu.consensus import messages as cmsg
+    from cometbft_tpu.consensus.state import ConsensusState
+    from cometbft_tpu.state import make_genesis_state
+    from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+    from cometbft_tpu.types.block import PRECOMMIT_TYPE
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    pvs = [MockPV() for _ in range(16)]
+    gen = GenesisDoc(
+        chain_id="memo-chain",
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    state = make_genesis_state(gen)
+
+    class FakeCS:
+        pass
+
+    cs = FakeCS()
+    cs.state = state
+    cs.logger = None
+    cs._failed_triples = {}
+    cs._FAILED_TRIPLES_MAX = ConsensusState._FAILED_TRIPLES_MAX
+
+    bid = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x07" * 32))
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    items = []
+    for idx, val in enumerate(state.validators.validators):
+        pv = pv_by_addr[val.address]
+        v = Vote(
+            type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+            timestamp=Time(1700000001, idx),
+            validator_address=pv.address(), validator_index=idx,
+        )
+        v = pv.sign_vote("memo-chain", v)
+        import dataclasses
+
+        v = dataclasses.replace(v, signature=bytes(64))  # garbage signature
+        items.append(("peer", cmsg.VoteMessage(v), "p"))
+
+    ConsensusState._prebatch_vote_signatures(cs, items)
+    assert counting_backend.calls == 1
+    assert len(cs._failed_triples) == 16
+    # replayed storm: all triples memoized bad -> no new dispatch
+    ConsensusState._prebatch_vote_signatures(cs, items)
+    assert counting_backend.calls == 1
