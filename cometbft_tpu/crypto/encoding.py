@@ -7,7 +7,7 @@ bn254=3} (proto/tendermint/crypto/keys.proto; bn254 is the fork's addition).
 from __future__ import annotations
 
 from cometbft_tpu import crypto
-from cometbft_tpu.crypto import bn254, ed25519, secp256k1
+from cometbft_tpu.crypto import bn254, ed25519, secp256k1, sr25519
 from cometbft_tpu.wire import proto as wire
 
 
@@ -19,6 +19,13 @@ def pub_key_to_proto(k: crypto.PubKey) -> bytes:
         return wire.field_bytes(2, k.bytes(), emit_default=True)
     if isinstance(k, bn254.PubKey):
         return wire.field_bytes(3, k.bytes(), emit_default=True)
+    if isinstance(k, sr25519.PubKey):
+        # EXTENSION beyond the reference: its keys.proto stops at bn254=3,
+        # so a Go node panics in Validator.Bytes() for sr25519 validators
+        # (types/validator.go:117-121) — sr25519 validator SETS are
+        # impossible there.  Field 4 makes them first-class here without
+        # disturbing any encoding the reference can produce.
+        return wire.field_bytes(4, k.bytes(), emit_default=True)
     raise ValueError(f"toproto: key type {k} is not supported")
 
 
@@ -49,6 +56,14 @@ def pub_key_from_proto(data: bytes) -> crypto.PubKey:
                 f"expected {bn254.PUB_KEY_SIZE}"
             )
         return bn254.PubKey(raw)
+    if 4 in fields:  # sr25519 extension (see pub_key_to_proto)
+        raw = fields[4][-1]
+        if len(raw) != sr25519.PUB_KEY_SIZE:
+            raise ValueError(
+                f"invalid size for PubKeySr25519. Got {len(raw)}, "
+                f"expected {sr25519.PUB_KEY_SIZE}"
+            )
+        return sr25519.PubKey(raw)
     raise ValueError("fromproto: key type is not supported")
 
 
@@ -56,11 +71,13 @@ _KEY_TYPE_TO_CLASS = {
     ed25519.KEY_TYPE: (ed25519.PubKey, ed25519.PUB_KEY_SIZE),
     secp256k1.KEY_TYPE: (secp256k1.PubKey, secp256k1.PUB_KEY_SIZE),
     bn254.KEY_TYPE: (bn254.PubKey, bn254.PUB_KEY_SIZE),
+    sr25519.KEY_TYPE: (sr25519.PubKey, sr25519.PUB_KEY_SIZE),
     # Amino-style names as they appear on the JSON wire (genesis files, RPC
     # /validators responses — types/genesis.go + rpc serialization).
     ed25519.PUB_KEY_NAME: (ed25519.PubKey, ed25519.PUB_KEY_SIZE),
     secp256k1.PUB_KEY_NAME: (secp256k1.PubKey, secp256k1.PUB_KEY_SIZE),
     bn254.PUB_KEY_NAME: (bn254.PubKey, bn254.PUB_KEY_SIZE),
+    sr25519.PUB_KEY_NAME: (sr25519.PubKey, sr25519.PUB_KEY_SIZE),
 }
 
 

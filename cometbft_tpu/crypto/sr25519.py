@@ -1,14 +1,29 @@
-"""Schnorr signatures over Ristretto255 (reference: crypto/sr25519/).
+"""schnorrkel-compatible sr25519 over Ristretto255 (reference: crypto/sr25519/).
 
 The reference backs this with curve25519-voi's schnorrkel implementation
-(sr25519/pubkey.go, sr25519/batch.go:18). This implementation uses a
-ristretto255 group (RFC 9496 encode/decode over the edwards25519 backend in
-ed25519_pure) with a domain-separated SHA-512 challenge in place of
-schnorrkel's merlin transcript — self-consistent sign/verify/batch inside this
-framework; wire compatibility with schnorrkel signatures is a non-goal for
-now and is documented as such.
+(sr25519/pubkey.go, sr25519/batch.go:18, privkey.go:16).  This module follows
+the same construction end to end:
+
+  - group: ristretto255 (RFC 9496) over the edwards25519 backend;
+  - signing context: merlin transcript ``Transcript("SigningContext")`` with
+    the empty context label, message appended under ``sign-bytes``
+    (privkey.go:16 NewSigningContext([]byte{}).NewTranscriptBytes);
+  - Schnorr challenge: ``proto-name``="Schnorr-sig", points committed under
+    ``sign:pk`` / ``sign:R``, 64-byte challenge under ``sign:c`` reduced
+    mod L (schnorrkel sign.rs);
+  - signature wire form: R || s with schnorrkel's high-bit marker on s
+    (byte 63 bit 7 set on encode, required + cleared on decode);
+  - key expansion: 32-byte MiniSecretKey -> SHA-512 -> ed25519-clamped
+    scalar divided by the cofactor + 32-byte transcript-witness nonce
+    (schnorrkel ExpandEd25519 — the substrate default), so a mini secret
+    from a real chain derives the identical public key;
+  - batch verification: random-linear-combination of the per-signature
+    Schnorr equations with per-signature transcript challenges
+    (sr25519/batch.go), per-signature fallback for the validity bitmap.
 
 Address is SHA256-20 of the raw pubkey bytes (sr25519/pubkey.go:26-31).
+The merlin/STROBE layer underneath is test-vector-validated
+(tests/test_merlin.py).
 """
 
 from __future__ import annotations
@@ -25,10 +40,10 @@ from cometbft_tpu.crypto.ed25519_pure import (
     P,
     SQRT_M1,
     point_add,
-    point_double,
     point_neg,
     scalar_mult,
 )
+from cometbft_tpu.crypto.merlin import Transcript
 
 KEY_TYPE = "sr25519"
 PUB_KEY_SIZE = 32
@@ -37,8 +52,6 @@ SIGNATURE_SIZE = 64
 
 PRIV_KEY_NAME = "tendermint/PrivKeySr25519"
 PUB_KEY_NAME = "tendermint/PubKeySr25519"
-
-_SIG_DOMAIN = b"cometbft-tpu/sr25519-schnorr-v1"
 
 # ---------------------------------------------------------------------------
 # ristretto255 (RFC 9496) over the edwards25519 backend
@@ -119,10 +132,35 @@ def ristretto_encode(p) -> bytes:
 # Ristretto basepoint = edwards25519 basepoint.
 from cometbft_tpu.crypto.ed25519_pure import BASE as _BASE  # noqa: E402
 
+# The reference constructs ONE signing context with the empty label
+# (privkey.go:16) and clones it per message.
+_SIGNING_CTX = Transcript(b"SigningContext")
+_SIGNING_CTX.append_message(b"", b"")
 
-def _challenge(r_bytes: bytes, pub: bytes, msg: bytes) -> int:
-    h = hashlib.sha512(_SIG_DOMAIN + r_bytes + pub + msg).digest()
-    return int.from_bytes(h, "little") % L
+
+def signing_transcript(msg: bytes) -> Transcript:
+    """NewSigningContext([]byte{}).NewTranscriptBytes(msg)."""
+    t = _SIGNING_CTX.clone()
+    t.append_message(b"sign-bytes", msg)
+    return t
+
+
+def _challenge(t: Transcript, pub: bytes, r_bytes: bytes) -> int:
+    """schnorrkel's challenge derivation on a signing transcript."""
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    t.append_message(b"sign:R", r_bytes)
+    return int.from_bytes(t.challenge_bytes(b"sign:c", 64), "little") % L
+
+
+def _decode_sig(sig: bytes) -> tuple[bytes, int] | None:
+    """(R_bytes, s) after checking/clearing the schnorrkel marker bit."""
+    if len(sig) != SIGNATURE_SIZE or not sig[63] & 0x80:
+        return None
+    s = int.from_bytes(sig[32:62] + bytes([sig[62], sig[63] & 0x7F]), "little")
+    if s >= L:
+        return None
+    return sig[:32], s
 
 
 class PubKey(crypto.PubKey):
@@ -136,33 +174,45 @@ class PubKey(crypto.PubKey):
         return self._bytes
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        if len(sig) != SIGNATURE_SIZE or len(self._bytes) != PUB_KEY_SIZE:
+        if len(self._bytes) != PUB_KEY_SIZE:
             return False
+        dec = _decode_sig(sig)
+        if dec is None:
+            return False
+        r_bytes, s = dec
         A = ristretto_decode(self._bytes)
-        R = ristretto_decode(sig[:32])
+        R = ristretto_decode(r_bytes)
         if A is None or R is None:
             return False
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:
-            return False
-        k = _challenge(sig[:32], self._bytes, msg)
-        # s·B == R + k·A
-        lhs = scalar_mult(s, _BASE)
-        rhs = point_add(R, scalar_mult(k, A))
-        diff = point_add(lhs, point_neg(rhs))
-        return ristretto_encode(diff) == ristretto_encode(IDENTITY)
+        k = _challenge(signing_transcript(msg), self._bytes, r_bytes)
+        # s·B - k·A == R  (compared in the canonical encoding)
+        rhs = point_add(scalar_mult(s, _BASE), point_neg(scalar_mult(k, A)))
+        return ristretto_encode(rhs) == r_bytes
 
     def type(self) -> str:
         return KEY_TYPE
+
+
+def _expand_ed25519(mini: bytes) -> tuple[int, bytes]:
+    """MiniSecretKey.ExpandEd25519 (schnorrkel keys.rs; substrate default):
+    SHA-512, ed25519 clamping, scalar divided by the cofactor; the second
+    half is the signing nonce."""
+    h = hashlib.sha512(mini).digest()
+    key = bytearray(h[:32])
+    key[0] &= 248
+    key[31] &= 63
+    key[31] |= 64
+    scalar = int.from_bytes(bytes(key), "little") >> 3
+    return scalar, h[32:64]
 
 
 class PrivKey(crypto.PrivKey):
     def __init__(self, data: bytes):
         if len(data) != PRIV_KEY_SIZE:
             raise ValueError(f"sr25519 privkey must be {PRIV_KEY_SIZE} bytes")
-        self._bytes = bytes(data)
-        self._scalar = int.from_bytes(self._bytes, "little") % L
-        if self._scalar == 0:
+        self._bytes = bytes(data)  # MiniSecretKey, like the reference's msk
+        self._scalar, self._nonce = _expand_ed25519(self._bytes)
+        if self._scalar % L == 0:
             raise ValueError("invalid sr25519 scalar")
 
     def bytes(self) -> bytes:
@@ -170,18 +220,21 @@ class PrivKey(crypto.PrivKey):
 
     def sign(self, msg: bytes) -> bytes:
         pub = self.pub_key().bytes()
-        # deterministic nonce (domain-separated), then Schnorr
-        r = (
-            int.from_bytes(
-                hashlib.sha512(b"nonce" + self._bytes + pub + msg).digest(), "little"
-            )
-            % L
-        )
-        R = scalar_mult(r, _BASE)
-        r_bytes = ristretto_encode(R)
-        k = _challenge(r_bytes, pub, msg)
+        t = signing_transcript(msg)
+        t.append_message(b"proto-name", b"Schnorr-sig")
+        t.append_message(b"sign:pk", pub)
+        # witness nonce: transcript RNG rekeyed with the expanded key's
+        # nonce half + system entropy (schnorrkel witness_scalar)
+        rng = t.build_rng().rekey_with_witness_bytes(b"signing", self._nonce)
+        rng.finalize(os.urandom(32))
+        r = int.from_bytes(rng.fill_bytes(64), "little") % L
+        r_bytes = ristretto_encode(scalar_mult(r, _BASE))
+        t.append_message(b"sign:R", r_bytes)
+        k = int.from_bytes(t.challenge_bytes(b"sign:c", 64), "little") % L
         s = (r + k * self._scalar) % L
-        return r_bytes + int.to_bytes(s, 32, "little")
+        s_bytes = bytearray(int.to_bytes(s, 32, "little"))
+        s_bytes[31] |= 0x80  # schnorrkel signature marker
+        return r_bytes + bytes(s_bytes)
 
     def pub_key(self) -> PubKey:
         return PubKey(ristretto_encode(scalar_mult(self._scalar, _BASE)))
@@ -191,18 +244,17 @@ class PrivKey(crypto.PrivKey):
 
 
 def gen_priv_key() -> PrivKey:
-    while True:
-        raw = os.urandom(PRIV_KEY_SIZE)
-        if int.from_bytes(raw, "little") % L != 0:
-            return PrivKey(raw)
+    # ExpandEd25519 clamping sets bit 254, so the expanded scalar always
+    # lies in [2^251, 2^252) — nonzero mod L for every seed.
+    return PrivKey(os.urandom(PRIV_KEY_SIZE))
 
 
 class BatchVerifier(crypto.BatchVerifier):
     """sr25519 batch verification (reference: sr25519/batch.go).
 
-    Random linear combination of Schnorr equations; on failure, per-signature
-    fallback produces the validity vector.
-    """
+    Random linear combination of the per-signature Schnorr equations
+    (transcript challenges included); on failure, per-signature fallback
+    produces the validity vector."""
 
     def __init__(self):
         self._entries: list[tuple[bytes, bytes, bytes]] = []
@@ -221,13 +273,14 @@ class BatchVerifier(crypto.BatchVerifier):
         decoded = []
         ok = [True] * n
         for i, (pub, msg, sig) in enumerate(self._entries):
+            dec = _decode_sig(sig)
             A = ristretto_decode(pub)
-            R = ristretto_decode(sig[:32])
-            s = int.from_bytes(sig[32:], "little")
-            if A is None or R is None or s >= L:
+            R = ristretto_decode(sig[:32]) if dec else None
+            if dec is None or A is None or R is None:
                 ok[i] = False
                 continue
-            decoded.append((A, R, s, _challenge(sig[:32], pub, msg)))
+            k = _challenge(signing_transcript(msg), pub, sig[:32])
+            decoded.append((A, R, dec[1], k))
         if all(ok):
             s_acc = 0
             acc = IDENTITY

@@ -12,10 +12,11 @@ Framing (:35-38,:185-260): ChaCha20-Poly1305 over 1028-byte frames
 (4-byte LE length + 1024 data max), 12-byte nonces with a little-endian
 64-bit counter in the low bytes, separate counters per direction.
 
-DEVIATION from the reference: the challenge is taken from the HKDF output
-(as in pre-0.34 Tendermint) instead of a merlin/STROBE transcript hash —
-structurally identical STS security, but not wire-interoperable with Go
-peers (SURVEY.md §7 hard part 5 defers exact transcript interop).
+The authentication challenge is the merlin transcript hash exactly as the
+reference computes it (secret_connection.go:111-135): a
+"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH" transcript absorbing the
+sorted ephemeral pubkeys and the DH secret, challenge extracted under the
+"SECRET_CONNECTION_MAC" label — byte-for-byte the Go handshake.
 """
 
 from __future__ import annotations
@@ -34,6 +35,7 @@ from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.crypto.encoding import pub_key_from_proto, pub_key_to_proto
+from cometbft_tpu.crypto.merlin import Transcript
 from cometbft_tpu.wire import proto as wire
 
 DATA_LEN_SIZE = 4
@@ -86,13 +88,17 @@ class SecretConnection:
         # Sorted ephemeral keys pick the HKDF key order.
         lo, hi = sorted([eph_pub, rem_eph_pub])
         loc_is_least = eph_pub == lo
+        transcript = Transcript(b"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH")
+        transcript.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo)
+        transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
         dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        transcript.append_message(b"DH_SECRET", dh_secret)
         okm = _hkdf_sha256(dh_secret, KEY_AND_CHALLENGE_GEN, 96)
         if loc_is_least:
             recv_secret, send_secret = okm[:32], okm[32:64]
         else:
             send_secret, recv_secret = okm[:32], okm[32:64]
-        challenge = okm[64:96]
+        challenge = transcript.extract_bytes(b"SECRET_CONNECTION_MAC", 32)
         self._send_aead = ChaCha20Poly1305(send_secret)
         self._recv_aead = ChaCha20Poly1305(recv_secret)
         # Authenticate: sign the challenge, swap AuthSig over the sealed channel.
