@@ -515,6 +515,32 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
         except Exception as e:
             plog(f"devnet stage failed: {type(e).__name__}: {e}")
 
+    # ---- loadtime: sustained-load block-interval/latency report over
+    # >= 100 blocks (test/loadtime + e2e/runner/benchmark.go:14-56) ----
+    if budget_left():
+        try:
+            from cometbft_tpu.loadtime import run_load
+
+            rep = run_load(rate=200, min_blocks=100, timeout_s=60)
+            stages["loadtime"] = {
+                "blocks": rep.blocks,
+                "tx_per_s": round(rep.tx_per_s, 1),
+                "block_interval_mean_s": round(rep.block_interval_mean_s, 4),
+                "block_interval_stddev_s": round(rep.block_interval_stddev_s, 4),
+                "block_interval_min_s": round(rep.block_interval_min_s, 4),
+                "block_interval_max_s": round(rep.block_interval_max_s, 4),
+                "tx_latency_p50_s": round(rep.tx_latency_p50_s, 4),
+                "tx_latency_p95_s": round(rep.tx_latency_p95_s, 4),
+            }
+            plog(
+                f"loadtime: {rep.blocks} blocks @ {rep.tx_per_s:.0f} tx/s, "
+                f"interval {rep.block_interval_mean_s*1000:.0f}"
+                f"±{rep.block_interval_stddev_s*1000:.0f} ms, "
+                f"tx p50 {rep.tx_latency_p50_s*1000:.0f} ms"
+            )
+        except Exception as e:
+            plog(f"loadtime stage failed: {type(e).__name__}: {e}")
+
     # ---- light-client bisection to height 500 over 4,096-val sets ----
     if budget_left():
         from cometbft_tpu.libs.db import MemDB

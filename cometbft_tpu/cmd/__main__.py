@@ -499,6 +499,23 @@ def cmd_testnet(args) -> int:
     return 0
 
 
+def cmd_loadtime(args) -> int:
+    """Load generator + saturation report (reference: test/loadtime +
+    test/e2e/runner/benchmark.go): sustained tx load against an in-process
+    devnet, mean/σ/min/max block interval and tx latency over the window."""
+    from cometbft_tpu.loadtime import run_load
+
+    rep = run_load(
+        n_vals=args.validators,
+        rate=args.rate,
+        min_blocks=args.blocks,
+        connections=args.connections,
+        log=lambda s: print(s, file=sys.stderr),
+    )
+    print(rep.to_json())
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cometbft_tpu")
     p.add_argument("--home", default=_default_home())
@@ -548,6 +565,11 @@ def main(argv=None) -> int:
     sp.add_argument("--validators", type=int, default=4)
     sp.add_argument("--output-dir", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
+    sp = sub.add_parser("loadtime")
+    sp.add_argument("--rate", type=int, default=200, help="target tx/s")
+    sp.add_argument("--connections", type=int, default=1)
+    sp.add_argument("--blocks", type=int, default=100)
+    sp.add_argument("--validators", type=int, default=4)
 
     args = p.parse_args(argv)
     handlers = {
@@ -570,6 +592,7 @@ def main(argv=None) -> int:
         "replay": cmd_replay,
         "replay-console": lambda a: cmd_replay(a, console=True),
         "debug": cmd_debug,
+        "loadtime": cmd_loadtime,
     }
     if args.command is None:
         p.print_help()

@@ -211,9 +211,9 @@ class PrivKey(crypto.PrivKey):
         if len(data) != PRIV_KEY_SIZE:
             raise ValueError(f"sr25519 privkey must be {PRIV_KEY_SIZE} bytes")
         self._bytes = bytes(data)  # MiniSecretKey, like the reference's msk
+        # ExpandEd25519 clamping guarantees scalar in [2^251, 2^252) — always
+        # nonzero mod L, so no validity check is needed here.
         self._scalar, self._nonce = _expand_ed25519(self._bytes)
-        if self._scalar % L == 0:
-            raise ValueError("invalid sr25519 scalar")
 
     def bytes(self) -> bytes:
         return self._bytes

@@ -93,6 +93,17 @@ class Node:
             self.block_indexer = KVBlockIndexer(
                 new_db("block_index", config.base.db_backend, db_dir)
             )
+        elif config.tx_index.indexer == "psql":
+            # SQL event sink (state/indexer/sink/psql): write-only relational
+            # indexing for external SQL consumers; /tx_search et al refuse.
+            from cometbft_tpu.state.sink_sql import SqlEventSink
+
+            conn = config.tx_index.psql_conn or os.path.join(
+                db_dir, "event_sink.sqlite"
+            )
+            self.event_sink = SqlEventSink(conn, genesis_doc.chain_id)
+            self.tx_indexer = self.event_sink.tx_indexer()
+            self.block_indexer = self.event_sink.block_indexer()
         else:
             self.tx_indexer = NullTxIndexer()
             self.block_indexer = NullTxIndexer()
@@ -377,10 +388,15 @@ class Node:
             self.switch.stop()
         self.indexer_service.stop()
         self.event_bus.stop()
+        if getattr(self, "event_sink", None) is not None:
+            self.event_sink.stop()
         if self.rpc_server:
             self.rpc_server.stop()
         if self.grpc_server is not None:
             self.grpc_server.stop()
+        # last: RPC handlers reach ABCI through these clients — close them
+        # only after no request can arrive
+        self.proxy_app.stop()
 
     @property
     def rpc_port(self) -> int:

@@ -166,3 +166,107 @@ def test_killed_node_catches_up_after_restart(testnet):
         blk = HTTPClient(f"http://127.0.0.1:{p}", timeout=5).block(h0)
         hashes.add(blk["block_id"]["hash"])
     assert len(hashes) == 1
+
+
+def test_partitioned_node_heals_and_chain_resumes(testnet):
+    """The reference e2e runner's 'disconnect' perturbation
+    (test/e2e/pkg/manifest.go:155-158): sever one validator's TCP
+    connections at the kernel level (SOCK_DESTROY via `ss -K`) and keep
+    severing for a window — with 2-of-3 at exactly 2/3 (not the strict
+    majority) the chain must stall; when the partition heals, the nodes'
+    own redial path must re-establish the mesh (no peer permanently
+    dropped — the r4 receive-error liveness fix) and the chain resumes."""
+    import re
+    import subprocess as sp
+
+    # SOCK_DESTROY needs CONFIG_INET_DIAG_DESTROY + a capable ss; probe on
+    # a throwaway loopback connection, else the "partition" is a no-op and
+    # the stall assertion fails spuriously.
+    probe_srv = socket.socket()
+    probe_srv.bind(("127.0.0.1", 0))
+    probe_srv.listen(1)
+    probe_cli = socket.create_connection(probe_srv.getsockname())
+    conn, _ = probe_srv.accept()
+    sp.run(
+        ["ss", "-K", "dport", str(probe_srv.getsockname()[1])],
+        capture_output=True,
+    )
+    try:
+        probe_cli.settimeout(1)
+        probe_cli.send(b"x")
+        conn.settimeout(1)
+        conn.recv(1)
+        pytest.skip("ss -K (SOCK_DESTROY) not supported on this kernel")
+    except OSError:
+        pass  # connection died: the perturbation tool works
+    finally:
+        for s in (probe_cli, conn, probe_srv):
+            s.close()
+
+    root, rpc_ports, procs, _ = testnet
+    h0 = _wait_height(rpc_ports[0], 3)
+    # the net must be demonstrably live and settled (earlier perturbation
+    # tests share this testnet) before we reason about a stall
+    _wait_height(rpc_ports[1], h0 + 2)
+    h0 = _wait_height(rpc_ports[0], h0 + 2)
+    pid1 = procs[1].pid
+
+    def sever():
+        """SOCK_DESTROY every established TCP connection owned by node1
+        EXCEPT its RPC listener's (we still want to observe it): kill by
+        exact 4-tuple so dialed-out conns (ephemeral source ports) die
+        too, not just the listener side."""
+        out = sp.run(
+            ["ss", "-tnp", "state", "established"],
+            capture_output=True, text=True,
+        ).stdout
+        for line in out.splitlines():
+            if f"pid={pid1}," not in line:
+                continue
+            m = re.search(
+                r"(\d+\.\d+\.\d+\.\d+):(\d+)\s+(\d+\.\d+\.\d+\.\d+):(\d+)", line
+            )
+            if not m:
+                continue
+            lip, lport, rip, rport = m.groups()
+            if int(lport) == rpc_ports[1] or int(rport) == rpc_ports[1]:
+                continue
+            sp.run(
+                ["ss", "-K", "src", lip, "sport", "=", lport,
+                 "dst", rip, "dport", "=", rport],
+                capture_output=True,
+            )
+
+    # partition window: keep killing re-established conns; measure the
+    # stall DURING the window (redial heals within a second of stopping)
+    rpc0 = HTTPClient(f"http://127.0.0.1:{rpc_ports[0]}", timeout=5)
+    t_end = time.time() + 7.0
+    stall_h = None
+    while time.time() < t_end:
+        sever()
+        if stall_h is None and time.time() > t_end - 5.0:
+            stall_h = int(rpc0.status()["sync_info"]["latest_block_height"])
+        time.sleep(0.15)
+    stall_h2 = int(rpc0.status()["sync_info"]["latest_block_height"])
+    # chain must have stalled: 2 validators hold exactly 2/3, not > 2/3
+    assert stall_h2 <= stall_h + 1, (
+        f"chain advanced {stall_h}->{stall_h2} during the partition"
+    )
+    # heal: stop severing; persistent-peer redial must rebuild the mesh
+    resumed = _wait_height(rpc_ports[1], stall_h2 + 3, timeout=300)
+    assert resumed >= stall_h2 + 3
+    # no peer permanently dropped: node1 sees both peers again
+    deadline = time.time() + 60
+    n_peers = 0
+    while time.time() < deadline:
+        try:
+            ni = HTTPClient(
+                f"http://127.0.0.1:{rpc_ports[1]}", timeout=5
+            ).call("net_info")
+            n_peers = int(ni["n_peers"])
+            if n_peers >= 2:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert n_peers >= 2, f"mesh not rebuilt: node1 has {n_peers} peers"

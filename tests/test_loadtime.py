@@ -1,0 +1,78 @@
+"""Loadtime analog (reference: test/loadtime + e2e/runner/benchmark.go):
+sustained-rate load generation and the block-interval/tx-latency report."""
+
+from cometbft_tpu.loadtime import (
+    Report,
+    build_report,
+    make_payload,
+    parse_payload,
+    run_load,
+)
+
+
+def test_payload_roundtrip():
+    tx = make_payload(7, 123456789, size=64)
+    assert len(tx) == 64
+    assert parse_payload(tx) == 123456789
+    assert parse_payload(b"not-a-load-tx") is None
+    assert parse_payload(b"load/malformed") is None
+
+
+def test_report_math():
+    class Blk:
+        def __init__(self, t, txs):
+            class H:
+                pass
+
+            class T:
+                seconds = int(t)
+                nanos = int((t - int(t)) * 1e9)
+
+            self.header = H()
+            self.header.time = T()
+
+            class D:
+                pass
+
+            self.data = D()
+            self.data.txs = txs
+
+    class Store:
+        def __init__(self, blocks):
+            self._b = blocks
+
+        def load_block(self, h):
+            return self._b.get(h)
+
+    t0 = 1700000000.0
+    blocks = {
+        1: Blk(t0 + 0.0, [make_payload(0, int((t0 - 0.05) * 1e9))]),
+        2: Blk(t0 + 1.0, []),
+        3: Blk(t0 + 3.0, [make_payload(1, int((t0 + 1.5) * 1e9))]),
+    }
+    rep = build_report(Store(blocks), 1, 3)
+    assert rep.blocks == 3
+    assert rep.txs_committed == 2
+    assert abs(rep.block_interval_mean_s - 1.5) < 1e-9
+    assert abs(rep.block_interval_min_s - 1.0) < 1e-9
+    assert abs(rep.block_interval_max_s - 2.0) < 1e-9
+    assert abs(rep.block_interval_stddev_s - 0.5) < 1e-9
+    # latencies: 0.05 and 1.5
+    assert abs(rep.tx_latency_max_s - 1.5) < 1e-6
+    assert abs(rep.tx_latency_mean_s - 0.775) < 1e-6
+
+
+def test_run_load_produces_report():
+    """A short sustained run: the window is fully covered, throughput is in
+    the neighborhood of the requested rate, latency is sane."""
+    rep = run_load(rate=150, min_blocks=25, timeout_s=90)
+    assert rep.blocks == 25
+    assert rep.txs_committed > 0
+    assert rep.block_interval_mean_s > 0
+    assert rep.tx_latency_p50_s > 0
+    assert rep.tx_per_s > 30, f"throughput collapsed: {rep.tx_per_s}"
+    assert rep.tx_latency_p95_s < 5.0, f"latency blew up: {rep.tx_latency_p95_s}"
+    # report serializes to one JSON line
+    import json
+
+    assert json.loads(rep.to_json())["blocks"] == 25

@@ -114,6 +114,22 @@ def test_sr25519_substrate_known_answer_vector():
     assert pub.verify_signature(b"anchored", sig)
 
 
+def test_sr25519_challenge_transcript_regression_pin():
+    """Pin the full Schnorr challenge path (SigningContext -> sign-bytes ->
+    proto-name -> sign:pk -> sign:R -> sign:c) to a fixed value computed by
+    this implementation: any future label/order slip changes the challenge
+    and breaks wire compatibility silently (sign/verify would remain
+    self-consistent).  Initial correctness of the ordering is anchored by
+    the merlin equivalence vector + the substrate pubkey KAT + construction
+    review against schnorrkel sign.rs."""
+    t = sr25519.signing_transcript(b"pinned message")
+    k = sr25519._challenge(t, b"\x11" * 32, b"\x22" * 32)
+    assert (
+        k.to_bytes(32, "little").hex()
+        == "d446512c70a39078bcd532e9f1be848043ffec732120d441a73dc2240b524c0f"
+    )
+
+
 def test_sr25519_expansion_is_deterministic_from_mini_secret():
     """ExpandEd25519: the same 32-byte mini secret must always derive the
     same public key (a substrate key imported twice is one validator)."""
