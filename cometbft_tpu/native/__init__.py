@@ -29,7 +29,7 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ("ed25519_msm.c", "sha256_merkle.c")
+_SOURCES = ("ed25519_msm.c", "sha256_merkle.c", "fe_ifma.c")
 _SO_PATH = os.path.join(_HERE, "_build", "libcmtpu_native.so")
 
 L = 2**252 + 27742317777372353535851937790883648493
@@ -107,6 +107,17 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
                     ctypes.c_void_p, ctypes.c_void_p,
                 ]
+                lib.cmtpu_sha512_batch.restype = None
+                lib.cmtpu_sha512_batch.argtypes = [
+                    ctypes.c_long, ctypes.c_char_p, ctypes.c_void_p,
+                    ctypes.c_void_p,
+                ]
+                lib.cmtpu_ed25519_scalar_prep.restype = None
+                lib.cmtpu_ed25519_scalar_prep.argtypes = [
+                    ctypes.c_long, ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p,
+                ]
                 _lib = lib
             except OSError:
                 _lib = None
@@ -166,59 +177,64 @@ def batch_verify(
     r_neg = ctypes.create_string_buffer(m * ge_size)
     dec_ok = ctypes.create_string_buffer(m)
     lib.cmtpu_ed25519_precheck(m, pub_buf, sig_buf, a_neg, r_neg, dec_ok)
-    dec = dec_ok.raw
 
-    # Scalars: s (range-checked), h = SHA512(R||A||M) mod L, random z,
-    # zh = z*h mod L — all little-endian 32-byte, indexed like a_neg/r_neg.
-    rand = os.urandom(16 * m)
-    s_int: list[int] = [0] * m
-    z_int: list[int] = [0] * m
-    z_bytes = bytearray(32 * m)
-    zh_bytes = bytearray(32 * m)
-    eligible: list[int] = []  # packed indices entering the batch equation
+    # Challenges h = SHA512(R||A||M), then all scalar work (s<L check,
+    # h mod L, z odd, zh = z*h, ssum accumulation) in one C pass.
+    chal_buf = b"".join(
+        sigs[i][:32] + pubs[i] + msgs[i] for i in cand
+    )
+    offs = (ctypes.c_uint64 * (m + 1))()
+    acc = 0
     for j, i in enumerate(cand):
-        if not dec[j]:
-            continue
-        s = int.from_bytes(sigs[i][32:], "little")
-        if s >= L:
-            continue
-        h = (
-            int.from_bytes(
-                hashlib.sha512(sigs[i][:32] + pubs[i] + msgs[i]).digest(),
-                "little",
-            )
-            % L
-        )
-        z = int.from_bytes(rand[16 * j : 16 * j + 16], "little") | 1
-        s_int[j] = s
-        z_int[j] = z
-        z_bytes[32 * j : 32 * j + 16] = rand[16 * j : 16 * j + 16]
-        z_bytes[32 * j] |= 1
-        zh_bytes[32 * j : 32 * j + 32] = (z * h % L).to_bytes(32, "little")
-        eligible.append(j)
+        offs[j] = acc
+        acc += 64 + len(msgs[i])
+    offs[m] = acc
+    digests = ctypes.create_string_buffer(64 * m)
+    lib.cmtpu_sha512_batch(m, chal_buf, offs, digests)
 
+    rand = os.urandom(16 * m)
+    z_buf = ctypes.create_string_buffer(32 * m)
+    zh_buf = ctypes.create_string_buffer(32 * m)
+    ssum_buf = ctypes.create_string_buffer(32)
+    lib.cmtpu_ed25519_scalar_prep(
+        m, digests, sig_buf, rand, z_buf, zh_buf, ssum_buf, dec_ok
+    )
+    okflags = dec_ok.raw  # decompress AND s-range survivors
+    eligible = [j for j in range(m) if okflags[j]]
     if not eligible:
         return False, bits
 
-    zb = bytes(z_bytes)
-    zhb = bytes(zh_bytes)
+    zb = z_buf.raw
+    zhb = zh_buf.raw
 
-    def check(subset: list[int]) -> bool:
-        ssum = 0
-        for j in subset:
-            ssum += z_int[j] * s_int[j]
-        ssum %= L
+    def check(subset: list[int], ssum: bytes) -> bool:
         idx = (ctypes.c_int64 * len(subset))(*subset)
         with _msm_lock:
             return bool(
                 lib.cmtpu_ed25519_check_subset(
-                    a_neg, r_neg, idx, len(subset),
-                    ssum.to_bytes(32, "little"), zb, zhb,
+                    a_neg, r_neg, idx, len(subset), ssum, zb, zhb,
                 )
             )
 
+    if check(eligible, ssum_buf.raw):
+        for j in eligible:
+            bits[cand[j]] = True
+        return all(bits), bits
+
+    # Batch failed: bisect.  Subset ssums need the integers — parse them
+    # once, only on this (rare, adversarial) path.
+    z_int = {
+        j: int.from_bytes(zb[32 * j : 32 * j + 32], "little") for j in eligible
+    }
+    s_int = {
+        j: int.from_bytes(sigs[cand[j]][32:], "little") for j in eligible
+    }
+
     def settle(subset: list[int]) -> None:
-        if check(subset):
+        ssum = 0
+        for j in subset:
+            ssum += z_int[j] * s_int[j]
+        if check(subset, (ssum % L).to_bytes(32, "little")):
             for j in subset:
                 bits[cand[j]] = True
             return
@@ -228,7 +244,10 @@ def batch_verify(
         settle(subset[:mid])
         settle(subset[mid:])
 
-    settle(eligible)
+    mid = len(eligible) // 2
+    if eligible[:mid]:
+        settle(eligible[:mid])
+    settle(eligible[mid:])
     return all(bits), bits
 
 

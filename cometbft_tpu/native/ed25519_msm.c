@@ -284,43 +284,101 @@ static void ge_neg(ge *r, const ge *p) {
     fe_neg(&r->T, &p->T);
 }
 
-/* ZIP-215 decompression: returns 1 on success */
-static int ge_frombytes_zip215(ge *h, const u8 s[32]) {
-    fe u, v, v3, vxx, check, x, y;
-    int sign = s[31] >> 7;
-    fe_frombytes(&y, s);
-    fe_sq(&u, &y);
-    fe_mul(&v, &u, &FE_D);
-    fe_sub(&u, &u, &FE_ONE); fe_carry(&u);       /* u = y^2 - 1 */
-    fe_add(&v, &v, &FE_ONE);                      /* v = d y^2 + 1 */
+/* ZIP-215 decompression, split so the fixed exponentiation can run
+ * 8-wide on IFMA hosts (fe_ifma.c): phase A derives u, v, v3 and the
+ * exponentiation input u*v^7; phase C finishes from pow = (u v^7)^((p-5)/8). */
+typedef struct {
+    fe u, v, v3, y, powin;
+    int sign;
+} dec_mid;
 
-    fe_sq(&v3, &v);
-    fe_mul(&v3, &v3, &v);                         /* v^3 */
-    fe_sq(&x, &v3);
-    fe_mul(&x, &x, &v);
-    fe_mul(&x, &x, &u);                           /* u v^7 */
-    fe_pow2523(&x, &x);                           /* (u v^7)^((p-5)/8) */
-    fe_mul(&x, &x, &v3);
-    fe_mul(&x, &x, &u);                           /* u v^3 (u v^7)^((p-5)/8) */
+static void decompress_phase_a(dec_mid *d, const u8 s[32]) {
+    fe t;
+    d->sign = s[31] >> 7;
+    fe_frombytes(&d->y, s);
+    fe_sq(&d->u, &d->y);
+    fe_mul(&d->v, &d->u, &FE_D);
+    fe_sub(&d->u, &d->u, &FE_ONE); fe_carry(&d->u);   /* u = y^2 - 1 */
+    fe_add(&d->v, &d->v, &FE_ONE);                    /* v = d y^2 + 1 */
+    fe_sq(&d->v3, &d->v);
+    fe_mul(&d->v3, &d->v3, &d->v);                    /* v^3 */
+    fe_sq(&t, &d->v3);
+    fe_mul(&t, &t, &d->v);
+    fe_mul(&d->powin, &t, &d->u);                     /* u v^7 */
+}
 
+static int decompress_phase_c(ge *h, const dec_mid *d, const fe *pow) {
+    fe x, vxx, check;
+    fe_mul(&x, pow, &d->v3);
+    fe_mul(&x, &x, &d->u);                 /* u v^3 (u v^7)^((p-5)/8) */
     fe_sq(&vxx, &x);
-    fe_mul(&vxx, &vxx, &v);
-    fe_sub(&check, &vxx, &u);
+    fe_mul(&vxx, &vxx, &d->v);
+    fe_sub(&check, &vxx, &d->u);
     if (!fe_iszero(&check)) {
-        fe_add(&check, &vxx, &u);
+        fe_add(&check, &vxx, &d->u);
         if (!fe_iszero(&check)) return 0;
         fe_mul(&x, &x, &FE_SQRTM1);
     }
     if (fe_iszero(&x)) {
-        if (sign) return 0;                       /* x=0 with sign bit set */
-    } else if (fe_isodd(&x) != sign) {
+        if (d->sign) return 0;             /* x=0 with sign bit set */
+    } else if (fe_isodd(&x) != d->sign) {
         fe_neg(&x, &x);
     }
     h->X = x;
-    h->Y = y;
+    h->Y = d->y;
     h->Z = FE_ONE;
-    fe_mul(&h->T, &x, &y);
+    fe_mul(&h->T, &x, &d->y);
     return 1;
+}
+
+static int ge_frombytes_zip215(ge *h, const u8 s[32]) {
+    dec_mid d;
+    fe pow;
+    decompress_phase_a(&d, s);
+    fe_pow2523(&pow, &d.powin);
+    return decompress_phase_c(h, &d, &pow);
+}
+
+/* radix-51 <-> radix-52 bridges for the IFMA lane layout */
+static void fe_to52(const fe *f, u64 out[5]) {
+    u8 b[32];
+    u64 w[4];
+    fe_tobytes(b, f);
+    memcpy(w, b, 32);
+    out[0] = w[0] & ((1ULL << 52) - 1);
+    out[1] = ((w[0] >> 52) | (w[1] << 12)) & ((1ULL << 52) - 1);
+    out[2] = ((w[1] >> 40) | (w[2] << 24)) & ((1ULL << 52) - 1);
+    out[3] = ((w[2] >> 28) | (w[3] << 36)) & ((1ULL << 52) - 1);
+    out[4] = w[3] >> 16;
+}
+
+static void fe_from52(const u64 in[5], fe *f) {
+    u8 b[32];
+    u64 w[4];
+    /* limbs may be non-canonical (< 2^52); fold into 256-bit then load.
+     * Total value < 2^256+eps... IFMA output limbs are < 2^52 so the
+     * packed value fits 260 bits; fold the top 4 bits via 2^256 mod p:
+     * simpler: combine as two 130-bit halves through fe arithmetic-free
+     * byte packing requires full canonicality, so reduce with bigint-ish
+     * carries first: value = sum in[k] 2^52k < 2^260; we use the fe
+     * radix-51 loader on the low 255 bits and add the high part times
+     * 2^255 mod p = 19. */
+    u64 l[5] = {in[0], in[1], in[2], in[3], in[4]};
+    /* pack low 255 bits */
+    w[0] = l[0] | (l[1] << 52);
+    w[1] = (l[1] >> 12) | (l[2] << 40);
+    w[2] = (l[2] >> 24) | (l[3] << 28);
+    w[3] = (l[3] >> 36) | (l[4] << 16);
+    u64 top = l[4] >> 48; /* bits >= 2^256... wait: l4 weight 2^208 */
+    memcpy(b, w, 32);
+    b[31] &= 0x7F;
+    u64 bit255 = (w[3] >> 63) & 1;
+    fe_frombytes(f, b);
+    /* add back bits 255.. : value_hi = top*2^256 + bit255*2^255
+     * 2^255 == 19, 2^256 == 38 (mod p) */
+    fe add = {{bit255 * 19 + top * 38, 0, 0, 0, 0}};
+    fe_add(f, f, &add);
+    fe_carry(f);
 }
 
 static int ge_is_identity(const ge *p) {
@@ -329,23 +387,65 @@ static int ge_is_identity(const ge *p) {
 
 /* ---- exported API (ctypes) ---- */
 
+/* fe_ifma.c: 8-wide x^((p-5)/8) on AVX-512 IFMA hosts */
+extern void cmtpu_fe8_pow2523(const u64 *in, u64 *out);
+extern int cmtpu_have_ifma(void);
+
 /* Decompress pubkeys and R components, negated, for the batch equation.
  * pubs: n*32, sigs: n*64 (R||s).  Aneg/Rneg: n ge slots (opaque to Python).
- * ok[i] = 1 if both decompressed (s-range is checked Python-side).
- * Returns the number of ok entries. */
+ * ok[i] = 1 if both decompressed; NOT final validity — the s < L range
+ * check runs in cmtpu_ed25519_scalar_prep, which clears ok[i] for
+ * out-of-range s.  Returns the number of ok entries.
+ *
+ * On IFMA hosts the per-point sqrt exponentiation — the bulk of
+ * decompression — runs 8 points per dispatch (4 signatures x {A, R}). */
 long cmtpu_ed25519_precheck(long n, const u8 *pubs, const u8 *sigs,
                             ge *Aneg, ge *Rneg, u8 *ok) {
+    static int have_ifma = -1;
+    if (have_ifma < 0) have_ifma = cmtpu_have_ifma();
     long good = 0;
-    for (long i = 0; i < n; i++) {
-        ge A, R;
-        if (ge_frombytes_zip215(&A, pubs + 32 * i) &&
-            ge_frombytes_zip215(&R, sigs + 64 * i)) {
-            ge_neg(&Aneg[i], &A);
-            ge_neg(&Rneg[i], &R);
-            ok[i] = 1;
-            good++;
-        } else {
-            ok[i] = 0;
+    if (!have_ifma) {
+        for (long i = 0; i < n; i++) {
+            ge A, R;
+            if (ge_frombytes_zip215(&A, pubs + 32 * i) &&
+                ge_frombytes_zip215(&R, sigs + 64 * i)) {
+                ge_neg(&Aneg[i], &A);
+                ge_neg(&Rneg[i], &R);
+                ok[i] = 1;
+                good++;
+            } else {
+                ok[i] = 0;
+            }
+        }
+        return good;
+    }
+    for (long base = 0; base < n; base += 4) {
+        long cnt = n - base < 4 ? n - base : 4;
+        dec_mid mid[8];
+        u64 lanes_in[40], lanes_out[40];
+        memset(lanes_in, 0, sizeof lanes_in);
+        for (long j = 0; j < cnt; j++) {
+            decompress_phase_a(&mid[2 * j], pubs + 32 * (base + j));
+            decompress_phase_a(&mid[2 * j + 1], sigs + 64 * (base + j));
+            fe_to52(&mid[2 * j].powin, lanes_in + 5 * (2 * j));
+            fe_to52(&mid[2 * j + 1].powin, lanes_in + 5 * (2 * j + 1));
+        }
+        cmtpu_fe8_pow2523(lanes_in, lanes_out);
+        for (long j = 0; j < cnt; j++) {
+            long i = base + j;
+            fe powA, powR;
+            ge A, R;
+            fe_from52(lanes_out + 5 * (2 * j), &powA);
+            fe_from52(lanes_out + 5 * (2 * j + 1), &powR);
+            if (decompress_phase_c(&A, &mid[2 * j], &powA) &&
+                decompress_phase_c(&R, &mid[2 * j + 1], &powR)) {
+                ge_neg(&Aneg[i], &A);
+                ge_neg(&Rneg[i], &R);
+                ok[i] = 1;
+                good++;
+            } else {
+                ok[i] = 0;
+            }
         }
     }
     return good;
@@ -418,3 +518,191 @@ int cmtpu_ed25519_check_subset(const ge *Aneg, const ge *Rneg,
 }
 
 long cmtpu_ge_size(void) { return (long)sizeof(ge); }
+
+/* ---- scalar arithmetic mod L (batch-equation coefficient prep) ----
+ *
+ * L = 2^252 + 27742317777372353535851937790883648493.  Values are 4x64-bit
+ * little-endian limbs; products/reductions via unsigned __int128 and the
+ * fold 2^252 == -C (mod L). */
+
+static const u64 SC_L[4] = {
+    0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL, 0, 0x1000000000000000ULL};
+/* C = L - 2^252 (125 bits) */
+static const u64 SC_C[2] = {0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL};
+
+/* a[n] >> 252, into out[m] (caller sizes m for the true width) */
+static void sc_shr252(const u64 *a, int n, u64 *out, int m) {
+    for (int i = 0; i < m; i++) {
+        u64 lo = (3 + i < n) ? (a[3 + i] >> 60) : 0;
+        u64 hi = (4 + i < n) ? (a[4 + i] << 4) : 0;
+        out[i] = lo | hi;
+    }
+}
+
+/* out[4] = a & (2^252 - 1) */
+static void sc_lo252(const u64 *a, int n, u64 out[4]) {
+    for (int i = 0; i < 4; i++) out[i] = (i < n) ? a[i] : 0;
+    out[3] &= (1ULL << 60) - 1;
+}
+
+/* out[n+2] = a[n] * C (C is 2 limbs) */
+static void sc_mul_c(const u64 *a, int n, u64 *out) {
+    for (int i = 0; i < n + 2; i++) out[i] = 0;
+    for (int i = 0; i < n; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 2; j++) {
+            u128 cur = (u128)out[i + j] + (u128)a[i] * SC_C[j] + carry;
+            out[i + j] = (u64)cur;
+            carry = cur >> 64;
+        }
+        int k = i + 2;
+        while (carry) {
+            u128 cur = (u128)out[k] + carry;
+            out[k] = (u64)cur;
+            carry = cur >> 64;
+            k++;
+        }
+    }
+}
+
+/* r (4 limbs) = x (8 limbs, < 2^512) mod L.
+ *
+ * Signed folding on 2^252 == -C (mod L), C = L - 2^252 (126 bits):
+ *   x = plus - m_lo + m2_lo - m3          with
+ *   m  = (x  >> 252) * C   (<= 386 bits)
+ *   m2 = (m  >> 252) * C   (<= 260 bits)
+ *   m3 = (m2 >> 252) * C   (<= 134 bits, already < 2^252)
+ * so  x ≡ (plus + m2_lo) + 8L - (m_lo + m3)  with every term < 2^253,
+ * then a bounded run of conditional subtracts normalizes into [0, L). */
+static void sc_reduce512(u64 r[4], const u64 x[8]) {
+    u64 plus[4], m[7], m_lo[4], m_hi[3], m2[5], m2_lo[4], m2_hi[1], m3[3];
+    u64 hi[5];
+    sc_lo252(x, 8, plus);
+    sc_shr252(x, 8, hi, 5);          /* <= 260 bits */
+    sc_mul_c(hi, 5, m);              /* <= 386 bits, 7 limbs */
+    sc_lo252(m, 7, m_lo);
+    sc_shr252(m, 7, m_hi, 3);        /* <= 134 bits */
+    sc_mul_c(m_hi, 3, m2);           /* <= 260 bits, 5 limbs */
+    sc_lo252(m2, 5, m2_lo);
+    sc_shr252(m2, 5, m2_hi, 1);      /* <= 8 bits */
+    sc_mul_c(m2_hi, 1, m3);          /* <= 134 bits, 3 limbs, < 2^252 */
+
+    /* acc = plus + m2_lo + 8L - m_lo - m3, all in 5 limbs */
+    u64 acc[5] = {0, 0, 0, 0, 0};
+    u128 carry = 0;
+    /* 8L = 2^255 + 8C */
+    u64 eightl[5];
+    eightl[0] = SC_C[0] << 3;
+    eightl[1] = (SC_C[1] << 3) | (SC_C[0] >> 61);
+    eightl[2] = SC_C[1] >> 61;
+    eightl[3] = 1ULL << 63;
+    eightl[4] = 0;
+    for (int i = 0; i < 5; i++) {
+        u128 t = carry + eightl[i];
+        if (i < 4) t += (u128)plus[i] + m2_lo[i];
+        acc[i] = (u64)t;
+        carry = t >> 64;
+    }
+    /* single 5-limb subtrahend (m_lo + m3), then one borrow chain */
+    u64 sub5[5] = {0, 0, 0, 0, 0};
+    carry = 0;
+    for (int i = 0; i < 5; i++) {
+        u128 t = carry;
+        if (i < 4) t += m_lo[i];
+        if (i < 3) t += m3[i];
+        sub5[i] = (u64)t;
+        carry = t >> 64;
+    }
+    u64 borrow_bit = 0;
+    for (int i = 0; i < 5; i++) {
+        u128 t = (u128)acc[i] - sub5[i] - borrow_bit;
+        acc[i] = (u64)t;
+        borrow_bit = (t >> 64) ? 1 : 0;
+    }
+    /* acc < 8L + 2^253 < 11*L: bounded conditional subtracts */
+    for (int rep = 0; rep < 12; rep++) {
+        int ge_l;
+        if (acc[4]) {
+            ge_l = 1;
+        } else {
+            ge_l = 1;
+            for (int i = 3; i >= 0; i--) {
+                if (acc[i] > SC_L[i]) { ge_l = 1; break; }
+                if (acc[i] < SC_L[i]) { ge_l = 0; break; }
+            }
+        }
+        if (!ge_l) break;
+        borrow_bit = 0;
+        for (int i = 0; i < 5; i++) {
+            u128 t = (u128)acc[i] - ((i < 4) ? SC_L[i] : 0) - borrow_bit;
+            acc[i] = (u64)t;
+            borrow_bit = (t >> 64) ? 1 : 0;
+        }
+    }
+    r[0] = acc[0]; r[1] = acc[1]; r[2] = acc[2]; r[3] = acc[3];
+}
+
+static void sc_mul(u64 r[4], const u64 a[4], const u64 b[4]) {
+    u64 t[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 cur = (u128)t[i + j] + (u128)a[i] * b[j] + carry;
+            t[i + j] = (u64)cur;
+            carry = cur >> 64;
+        }
+        t[i + 4] = (u64)carry;
+    }
+    sc_reduce512(r, t);
+}
+
+static void sc_add(u64 r[4], const u64 a[4], const u64 b[4]) {
+    u64 t[8] = {0};
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 cur = (u128)a[i] + b[i] + carry;
+        t[i] = (u64)cur;
+        carry = cur >> 64;
+    }
+    t[4] = (u64)carry;
+    sc_reduce512(r, t);
+}
+
+/* s < L, strict (the RFC 8032 / ZIP-215 s-range check) */
+static int sc_lt_l(const u64 s[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (s[i] < SC_L[i]) return 1;
+        if (s[i] > SC_L[i]) return 0;
+    }
+    return 0; /* equal */
+}
+
+/* Batch scalar prep: for each entry i with ok[i] set on input (decompress
+ * passed), check s < L (clearing ok[i] otherwise), compute
+ *   h_i = digest_i mod L          (64-byte SHA-512 output)
+ *   z_i = z16_i | 1               (forced odd, 128-bit)
+ *   zh_i = z_i * h_i mod L
+ * and accumulate ssum = sum z_i * s_i mod L over surviving entries.
+ * Buffers are all little-endian; z32/zh32 are the MSM coefficient arrays. */
+void cmtpu_ed25519_scalar_prep(long n, const u8 *digests, const u8 *sigs,
+                               const u8 *z16, u8 *z32, u8 *zh32,
+                               u8 *ssum32, u8 *ok) {
+    u64 ssum[4] = {0, 0, 0, 0};
+    for (long i = 0; i < n; i++) {
+        if (!ok[i]) continue;
+        u64 s[4];
+        memcpy(s, sigs + 64 * i + 32, 32);
+        if (!sc_lt_l(s)) { ok[i] = 0; continue; }
+        u64 d[8], h[4], z[4] = {0, 0, 0, 0}, zh[4], zs[4];
+        memcpy(d, digests + 64 * i, 64);
+        sc_reduce512(h, d);
+        memcpy(z, z16 + 16 * i, 16);
+        z[0] |= 1;
+        sc_mul(zh, z, h);
+        sc_mul(zs, z, s);
+        sc_add(ssum, ssum, zs);
+        memcpy(z32 + 32 * i, z, 32);
+        memcpy(zh32 + 32 * i, zh, 32);
+    }
+    memcpy(ssum32, ssum, 32);
+}
