@@ -930,7 +930,22 @@ class ConsensusState:
             return False
         if rs.proposal_block_parts is None:
             return False
-        added = rs.proposal_block_parts.add_part(msg.part)
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except ValueError:
+            if msg.round != rs.round:
+                # A part from an earlier round's proposal at the same height
+                # fails the proof check against the current round's part-set
+                # header — benign late gossip, not a bad peer; don't take
+                # down message processing for it.
+                if self.logger:
+                    self.logger.debug(
+                        f"block part from another round does not match "
+                        f"current proposal (h={msg.height} r={msg.round} "
+                        f"cs_round={rs.round})"
+                    )
+                return False
+            raise  # same-round invalid proof: a genuinely faulty peer
         if added and rs.proposal_block_parts.is_complete():
             from cometbft_tpu.types.block import Block
 

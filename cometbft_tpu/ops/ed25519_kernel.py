@@ -108,7 +108,17 @@ def _verify_from_words(a_words, r_words, s_words, k_words):
         a = tuple(c[:, :n] for c in pt)
         r = tuple(c[:, n:] for c in pt)
         neg_a = ed.point_neg(a)
-    acc = ed.windowed_double_base_mult(s_digits, k_digits, neg_a)
+    if os.environ.get("CMTPU_LADDER", "xla") == "pallas":
+        # Opt-in A/B probe (ops/pallas_ladder.py): the whole ladder as one
+        # Mosaic kernel — attacks the XLA graph-size ceiling directly.
+        from cometbft_tpu.ops import pallas_ladder
+
+        acc = pallas_ladder.windowed_double_base_mult(
+            s_digits, k_digits, neg_a,
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        acc = ed.windowed_double_base_mult(s_digits, k_digits, neg_a)
     with fe.compact_scope():
         acc = ed.point_add(acc, ed.point_neg(r))
         acc = ed.point_double(ed.point_double(ed.point_double(acc)))

@@ -245,7 +245,7 @@ def build_table_pre(p) -> jnp.ndarray:
     return tbl
 
 
-def _host_table_b() -> jnp.ndarray:
+def _host_table_b() -> np.ndarray:
     """Constant table [0..8]B in precomp form: int32[9, 4, 17, 1], computed
     with host integer math at import (the fixed-base precomputation — B is a
     compile-time constant, so [s]B rides the same select/add path as [k]A
@@ -283,7 +283,8 @@ def _host_table_b() -> jnp.ndarray:
             )
         )
         cur = add_int(cur, (_BX, _BY))
-    return jnp.asarray(np.stack(rows)[:, :, :, None])  # [9, 4, 17, 1]
+    # numpy literal so the Pallas kernel can close over it (see const_fe)
+    return np.stack(rows)[:, :, :, None]  # [9, 4, 17, 1]
 
 
 TABLE_B_PRE = _host_table_b()

@@ -77,9 +77,12 @@ _P_LIMBS = [int(x) for x in int_to_limbs(P_INT)]
 _FOUR_P = np.array([4 * x for x in _P_LIMBS], np.int32).reshape(LIMBS, 1)
 
 
-def const_fe(v: int) -> jnp.ndarray:
-    """Field constant as int32[17, 1] (broadcasts over the batch)."""
-    return jnp.asarray(int_to_limbs(v).reshape(LIMBS, 1))
+def const_fe(v: int) -> np.ndarray:
+    """Field constant as int32[17, 1] (broadcasts over the batch).  Kept as
+    a NUMPY literal: jnp consumers convert on use, and the Pallas ladder
+    kernel (ops/pallas_ladder.py) can close over it — Pallas rejects
+    captured traced arrays but inlines host constants."""
+    return int_to_limbs(v).reshape(LIMBS, 1)
 
 
 def fe_from_bytes_le(b: np.ndarray) -> np.ndarray:

@@ -183,3 +183,36 @@ def test_set_proposal_rejects_forged_and_bad_pol(tmp_path):
     finally:
         for cs_, _, _ in nodes:
             cs_.stop()
+
+
+def test_mismatched_block_part_is_rejected_quietly(net4):
+    """A part that fails the proof check against the current proposal's
+    part-set header (late gossip from an earlier round at the same height)
+    must return False without raising — state.go:1929-1933 treats it as
+    benign, not a peer fault."""
+    from cometbft_tpu.consensus.messages import BlockPartMessage
+    from cometbft_tpu.types.part_set import PartSet
+
+    import pytest
+
+    cs = net4[0][0]
+    wrong = PartSet.from_data(b"some other block entirely" * 100)
+    # multi-part so adding one matching part cannot complete (and trigger
+    # a Block.decode of this synthetic data)
+    right = PartSet.from_data(b"the proposal this round is about" * 100_000)
+    assert right.total > 1
+    cs.rs.height = 5
+    cs.rs.round = 1
+    cs.rs.proposal_block_parts = PartSet(right.header())
+    # earlier-round part that fails the proof: quiet False
+    msg = BlockPartMessage(height=5, round=0, part=wrong.get_part(0))
+    assert cs._add_proposal_block_part(msg, "peer-x") is False
+    assert cs.rs.proposal_block_parts.count == 0
+    # SAME-round invalid proof keeps its faulty-peer error signal
+    msg_bad = BlockPartMessage(height=5, round=1, part=wrong.get_part(0))
+    with pytest.raises(ValueError):
+        cs._add_proposal_block_part(msg_bad, "peer-x")
+    # and a matching part still lands
+    msg2 = BlockPartMessage(height=5, round=1, part=right.get_part(0))
+    assert cs._add_proposal_block_part(msg2, "peer-x") is True
+    assert cs.rs.proposal_block_parts.count == 1

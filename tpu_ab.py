@@ -1,13 +1,16 @@
 """A/B the field-multiply lowerings on the real device (run by tpu_watch.sh
 after a successful bench, or by hand when the relay is up).
 
-For each CMTPU_FE_MODE in (stacked, compact, planar) spawn a fresh worker
-process (the mode is sampled at import) that compiles the 10,240-lane verify
-program and times steady-state dispatches. planar goes last under a hard
-timeout: its compile has never finished on the device (>8 min observed) and
-a hang must not eat the tunnel-up window.
+For each probe spawn a fresh worker process (the mode is sampled at import)
+that compiles the 10,240-lane verify program and times steady-state
+dispatches.  Probes: the three CMTPU_FE_MODE XLA lowerings (stacked /
+compact / planar) and the CMTPU_LADDER=pallas Mosaic ladder kernel
+(ops/pallas_ladder.py — weak-#5: the planar arithmetic inside one kernel,
+dodging the XLA graph-size ceiling).  planar goes late under a hard
+timeout: its XLA compile has never finished on the device (>8 min
+observed) and a hang must not eat the tunnel-up window.
 
-Appends one JSON line per mode to stdout; tpu_watch.sh redirects to
+Appends one JSON line per probe to stdout; tpu_watch.sh redirects to
 tpu_ab.log.
 """
 
@@ -19,7 +22,13 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 N = int(os.environ.get("CMTPU_AB_SIGS", "10240"))
-MODES = (("stacked", 600), ("compact", 600), ("planar", 420))
+# (label, extra env, timeout)
+MODES = (
+    ("stacked", {"CMTPU_FE_MODE": "stacked"}, 600),
+    ("compact", {"CMTPU_FE_MODE": "compact"}, 600),
+    ("pallas", {"CMTPU_FE_MODE": "stacked", "CMTPU_LADDER": "pallas"}, 600),
+    ("planar", {"CMTPU_FE_MODE": "planar"}, 420),
+)
 
 
 def worker(mode: str) -> None:
@@ -92,8 +101,8 @@ def main() -> int:
     if "--best" in sys.argv:
         print(best_mode())
         return 0
-    for mode, tmo in MODES:
-        env = {**os.environ, "CMTPU_FE_MODE": mode}
+    for mode, extra_env, tmo in MODES:
+        env = {**os.environ, **extra_env, "CMTPU_AB_LABEL": mode}
         try:
             out = subprocess.run(
                 [sys.executable, "-u", __file__, "--worker"],
@@ -118,6 +127,10 @@ def main() -> int:
 
 if __name__ == "__main__":
     if "--worker" in sys.argv:
-        worker(os.environ.get("CMTPU_FE_MODE", "auto"))
+        worker(
+            os.environ.get(
+                "CMTPU_AB_LABEL", os.environ.get("CMTPU_FE_MODE", "auto")
+            )
+        )
     else:
         sys.exit(main())

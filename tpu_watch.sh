@@ -42,7 +42,11 @@ for i in $(seq 1 1400); do
     # for every later bench run (otherwise the next loop iteration would
     # clobber the better alt-mode result with the default mode's).
     FE_MODE=$(cat .tpu_fe_mode 2>/dev/null || true)
-    if [ -n "$FE_MODE" ]; then
+    # "pallas" is the Mosaic ladder probe (CMTPU_LADDER), not an fe mode.
+    if [ "$FE_MODE" = "pallas" ]; then
+      CMTPU_LADDER=pallas timeout 1500 python -u bench.py \
+        > tpu_bench.out 2> tpu_bench.err
+    elif [ -n "$FE_MODE" ]; then
       CMTPU_FE_MODE="$FE_MODE" timeout 1500 python -u bench.py \
         > tpu_bench.out 2> tpu_bench.err
     else
@@ -85,8 +89,13 @@ for i in $(seq 1 1400); do
                timeout 60 python tpu_ab.py --best 2>/dev/null)
         if [ -n "$BEST" ] && [ "$BEST" != "stacked" ]; then
           log "A/B winner is $BEST; re-running bench with it"
-          CMTPU_FE_MODE="$BEST" timeout 1500 python -u bench.py \
-            > tpu_bench_alt.out 2>> tpu_watch.log
+          if [ "$BEST" = "pallas" ]; then
+            CMTPU_LADDER=pallas timeout 1500 python -u bench.py \
+              > tpu_bench_alt.out 2>> tpu_watch.log
+          else
+            CMTPU_FE_MODE="$BEST" timeout 1500 python -u bench.py \
+              > tpu_bench_alt.out 2>> tpu_watch.log
+          fi
           # Adopt the mode ONLY if the full bench agrees it is better
           # (microbench winners can lose end-to-end); otherwise clear any
           # stale sticky mode so later runs use the default.
