@@ -516,6 +516,21 @@ def cmd_loadtime(args) -> int:
     return 0
 
 
+def cmd_e2e(args) -> int:
+    """Manifest-driven e2e testnet run (reference: test/e2e/runner)."""
+    import tempfile
+
+    from cometbft_tpu.e2e_runner import E2ERunner
+
+    out = args.output_dir or tempfile.mkdtemp(prefix="cmtpu-e2e-")
+    runner = E2ERunner(
+        args.manifest, out, log=lambda s: print(s, file=sys.stderr)
+    )
+    report = runner.run()
+    print(json.dumps(report))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cometbft_tpu")
     p.add_argument("--home", default=_default_home())
@@ -570,6 +585,9 @@ def main(argv=None) -> int:
     sp.add_argument("--connections", type=int, default=1)
     sp.add_argument("--blocks", type=int, default=100)
     sp.add_argument("--validators", type=int, default=4)
+    sp = sub.add_parser("e2e")
+    sp.add_argument("--manifest", required=True, help="TOML testnet manifest")
+    sp.add_argument("--output-dir", default="")
 
     args = p.parse_args(argv)
     handlers = {
@@ -593,6 +611,7 @@ def main(argv=None) -> int:
         "replay-console": lambda a: cmd_replay(a, console=True),
         "debug": cmd_debug,
         "loadtime": cmd_loadtime,
+        "e2e": cmd_e2e,
     }
     if args.command is None:
         p.print_help()

@@ -179,13 +179,26 @@ class ConsensusReactor(Reactor):
 
     # -- broadcast round steps (reactor.go broadcastNewRoundStepMessage) ------
 
+    # Re-announce our round step even without a change: peers track our
+    # height from these messages, and the channel is lossy (try_send
+    # broadcasts, reconnections).  A STUCK node is exactly the one whose
+    # step never changes — without the refresh, a peer whose PeerState for
+    # us was lost to a reconnect keeps height 0 forever, its catch-up
+    # gossip never engages, and a 4/0/6-vs-5/0/4 partition aftermath
+    # deadlocks permanently (found by the e2e disconnect perturbation).
+    ROUND_STEP_REFRESH_S = 1.0
+
     def _broadcast_round_step_routine(self) -> None:
         last = None
+        last_sent = 0.0
         while self._running:
             rs = self.cs.rs
             cur = (rs.height, rs.round, rs.step)
-            if cur != last and self.switch is not None:
+            now = time.monotonic()
+            if (cur != last or now - last_sent >= self.ROUND_STEP_REFRESH_S) \
+                    and self.switch is not None:
                 last = cur
+                last_sent = now
                 msg = self._round_step_msg(rs)
                 self.switch.broadcast(
                     CONSENSUS_STATE_CHANNEL, cmsg.encode_consensus_message(msg)
@@ -202,7 +215,10 @@ class ConsensusReactor(Reactor):
         )
 
     def _send_round_step(self, peer) -> None:
-        peer.try_send(
+        # Reliable send (blocking enqueue): this is the message that seeds
+        # the peer's PeerState height — dropping it on a full queue would
+        # disable catch-up gossip toward us until the next refresh.
+        peer.send(
             CONSENSUS_STATE_CHANNEL,
             cmsg.encode_consensus_message(self._round_step_msg(self.cs.rs)),
         )

@@ -1,0 +1,297 @@
+"""Manifest-driven e2e testnet runner (reference: test/e2e/pkg/manifest.go +
+test/e2e/runner).
+
+The reference drives docker-compose testnets from a TOML manifest: node
+topology, per-node perturbation schedules (kill / pause / disconnect /
+restart), transaction load, then a liveness + hash-agreement check and an
+optional benchmark report.  This is that runner over OS processes on
+loopback (the deployment substrate this framework's e2e tier uses —
+tests/test_e2e_processes.py holds the individual perturbations to their
+semantics; this module sequences them from a manifest).
+
+Manifest subset (same field names as the reference where they apply):
+
+    initial_height = 1
+    load_tx_rate = 100          # tx/s sustained against node 0
+    target_blocks = 12          # blocks every node must reach post-perturb
+    [node.validator01]
+    [node.validator02]
+    perturb = ["pause", "kill"]
+    [node.validator03]
+    perturb = ["disconnect"]
+
+Run: ``python -m cometbft_tpu.cmd e2e --manifest m.toml`` or
+``E2ERunner(manifest_path).run()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ManifestNode:
+    name: str
+    perturb: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Manifest:
+    initial_height: int = 1
+    load_tx_rate: int = 50
+    target_blocks: int = 8
+    nodes: list[ManifestNode] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        nodes = [
+            ManifestNode(name=name, perturb=list(spec.get("perturb", [])))
+            for name, spec in raw.get("node", {}).items()
+        ]
+        if not nodes:
+            raise ValueError("manifest has no [node.*] entries")
+        known = {"kill", "pause", "disconnect", "restart"}
+        for n in nodes:
+            bad = set(n.perturb) - known
+            if bad:
+                raise ValueError(f"{n.name}: unknown perturbations {sorted(bad)}")
+        return cls(
+            initial_height=int(raw.get("initial_height", 1)),
+            load_tx_rate=int(raw.get("load_tx_rate", 50)),
+            target_blocks=int(raw.get("target_blocks", 8)),
+            nodes=nodes,
+        )
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class E2ERunner:
+    def __init__(self, manifest_path: str, home: str, log=print):
+        self.manifest = Manifest.load(manifest_path)
+        self.home = home
+        self.log = log
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.rpc_ports: dict[str, int] = {}
+        self.p2p_ports: dict[str, int] = {}
+
+    # -- setup ------------------------------------------------------------
+
+    def setup(self) -> None:
+        """testnet homes + config.toml per node (runner/setup.go shape)."""
+        from cometbft_tpu.cmd.__main__ import main as cli
+        from cometbft_tpu.config import default_config
+        from cometbft_tpu.config.toml import write_config_file
+        from cometbft_tpu.p2p.key import NodeKey
+
+        names = [n.name for n in self.manifest.nodes]
+        assert cli(
+            ["testnet", "--validators", str(len(names)),
+             "--output-dir", self.home, "--chain-id", "e2e-manifest"]
+        ) == 0
+        p2p = _free_ports(len(names))
+        rpc = _free_ports(len(names))
+        node_ids = [
+            NodeKey.load(
+                os.path.join(self.home, f"node{i}", "config", "node_key.json")
+            ).id
+            for i in range(len(names))
+        ]
+        peers = [
+            f"{node_ids[i]}@127.0.0.1:{p2p[i]}" for i in range(len(names))
+        ]
+        for i, name in enumerate(names):
+            home = os.path.join(self.home, f"node{i}")
+            cfg = default_config()
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc[i]}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p[i]}"
+            cfg.p2p.persistent_peers = ",".join(
+                p for j, p in enumerate(peers) if j != i
+            )
+            cfg.p2p.addr_book_strict = False
+            cfg.consensus.timeout_commit = 0.2
+            cfg.consensus.skip_timeout_commit = False
+            write_config_file(os.path.join(home, "config", "config.toml"), cfg)
+            self.rpc_ports[name] = rpc[i]
+            self.p2p_ports[name] = p2p[i]
+
+    def _launch(self, idx: int) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu.cmd", "--home",
+             os.path.join(self.home, f"node{idx}"), "start"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    def start(self) -> None:
+        for i, node in enumerate(self.manifest.nodes):
+            self.procs[node.name] = self._launch(i)
+        self.log(f"started {len(self.procs)} nodes")
+
+    # -- RPC helpers ------------------------------------------------------
+
+    def _height(self, name: str) -> int:
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        st = HTTPClient(
+            f"http://127.0.0.1:{self.rpc_ports[name]}", timeout=3
+        ).status()
+        return int(st["sync_info"]["latest_block_height"])
+
+    def wait_height(self, name: str, target: int, timeout: float = 240) -> int:
+        deadline = time.time() + timeout
+        last = -1
+        while time.time() < deadline:
+            try:
+                last = self._height(name)
+                if last >= target:
+                    return last
+            except Exception:
+                pass
+            time.sleep(0.3)
+        raise TimeoutError(f"{name}: height {target} not reached (last {last})")
+
+    # -- perturbations (runner/perturb.go) --------------------------------
+
+    def perturb(self, node: ManifestNode, kind: str) -> None:
+        name = node.name
+        idx = [n.name for n in self.manifest.nodes].index(name)
+        proc = self.procs[name]
+        self.log(f"perturb {name}: {kind}")
+        if kind == "kill" or kind == "restart":
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+        elif kind == "pause":
+            proc.send_signal(signal.SIGSTOP)
+            time.sleep(3.0)
+            proc.send_signal(signal.SIGCONT)
+        elif kind == "disconnect":
+            pid = proc.pid
+            t_end = time.time() + 4.0
+            while time.time() < t_end:
+                out = subprocess.run(
+                    ["ss", "-tnp", "state", "established"],
+                    capture_output=True, text=True,
+                ).stdout
+                for line in out.splitlines():
+                    if f"pid={pid}," not in line:
+                        continue
+                    m = re.search(
+                        r"(\d+\.\d+\.\d+\.\d+):(\d+)\s+"
+                        r"(\d+\.\d+\.\d+\.\d+):(\d+)", line)
+                    if not m:
+                        continue
+                    lip, lport, rip, rport = m.groups()
+                    if int(lport) == self.rpc_ports[name] or \
+                       int(rport) == self.rpc_ports[name]:
+                        continue
+                    subprocess.run(
+                        ["ss", "-K", "src", lip, "sport", "=", lport,
+                         "dst", rip, "dport", "=", rport],
+                        capture_output=True,
+                    )
+                time.sleep(0.2)
+        else:
+            raise ValueError(kind)
+        # After every perturbation the node must make progress again.  The
+        # heal window is generous: a stall grows consensus round timeouts
+        # (the reference's per-round timeout deltas), so the first
+        # post-heal commit can take minutes after a partition.
+        h = self.wait_height(self.manifest.nodes[0].name, 1)
+        self.wait_height(name, h + 1, timeout=420)
+        self.log(f"perturb {name}: {kind} healed")
+
+    # -- load (loadtime payloads over RPC) --------------------------------
+
+    def _load_pump(self, stop: threading.Event) -> None:
+        from cometbft_tpu.loadtime import make_payload
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        rate = max(1, self.manifest.load_tx_rate)
+        target = self.manifest.nodes[0].name
+        k = 0
+        next_t = time.monotonic()
+        while not stop.is_set():
+            try:
+                cli = HTTPClient(
+                    f"http://127.0.0.1:{self.rpc_ports[target]}", timeout=3
+                )
+                tx = make_payload(k, time.time_ns())
+                cli.call("broadcast_tx_async", tx="0x" + tx.hex())
+                k += 1
+            except Exception:
+                pass
+            next_t += 1.0 / rate
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    # -- the run ----------------------------------------------------------
+
+    def run(self) -> dict:
+        self.setup()
+        self.start()
+        stop = threading.Event()
+        pump = threading.Thread(target=self._load_pump, args=(stop,), daemon=True)
+        try:
+            first = self.manifest.nodes[0].name
+            h0 = self.wait_height(first, self.manifest.initial_height + 2)
+            pump.start()
+            for node in self.manifest.nodes:
+                for kind in node.perturb:
+                    self.perturb(node, kind)
+            target = h0 + self.manifest.target_blocks
+            heights = {
+                n.name: self.wait_height(n.name, target, timeout=420)
+                for n in self.manifest.nodes
+            }
+            # hash agreement at a common committed height (runner/test.go)
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            common = min(heights.values())
+            hashes = {
+                n.name: HTTPClient(
+                    f"http://127.0.0.1:{self.rpc_ports[n.name]}", timeout=5
+                ).block(common)["block_id"]["hash"]
+                for n in self.manifest.nodes
+            }
+            if len(set(hashes.values())) != 1:
+                raise AssertionError(f"hash disagreement at {common}: {hashes}")
+            report = {
+                "nodes": len(self.manifest.nodes),
+                "perturbations": sum(len(n.perturb) for n in self.manifest.nodes),
+                "final_heights": heights,
+                "agreed_height": common,
+                "agreed_hash": next(iter(hashes.values())),
+            }
+            self.log(json.dumps(report))
+            return report
+        finally:
+            stop.set()
+            for proc in self.procs.values():
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()

@@ -121,6 +121,12 @@ class PexReactor(Reactor):
     def remove_peer(self, peer, reason) -> None:
         with self._mtx:
             self._requests_sent.discard(peer.id)
+            # The inbound rate-limit clock must die with the connection
+            # (pex_reactor.go:206-212 deletes lastReceivedRequests): a peer
+            # reconnecting after a partition asks for addresses immediately,
+            # and a stale timestamp would punish it as an abuser — dropping
+            # the peer again and looping redial against the rate limit.
+            self._last_request_from.pop(peer.id, None)
 
     def _peer_net_address(self, peer) -> NetAddress | None:
         """Observed IP + self-reported listen port (pex_reactor.go uses
@@ -139,11 +145,15 @@ class PexReactor(Reactor):
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         kind, payload = decode_pex_message(msg_bytes)
         if kind == "request":
-            now = time.monotonic()
-            last = self._last_request_from.get(peer.id, 0.0)
-            if now - last < self.request_interval and not self.seed_mode:
-                raise ValueError("peer is asking for addresses too often")
-            self._last_request_from[peer.id] = now
+            # Check-and-set under the same lock remove_peer pops under: an
+            # in-flight request racing a disconnect must not write a stale
+            # timestamp back after the pop (it would punish the reconnect).
+            with self._mtx:
+                now = time.monotonic()
+                last = self._last_request_from.get(peer.id, 0.0)
+                if now - last < self.request_interval and not self.seed_mode:
+                    raise ValueError("peer is asking for addresses too often")
+                self._last_request_from[peer.id] = now
             sel = self.book.get_selection()
             me = self._self_net_address()
             if me is not None:
