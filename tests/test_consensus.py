@@ -216,3 +216,46 @@ def test_mismatched_block_part_is_rejected_quietly(net4):
     msg2 = BlockPartMessage(height=5, round=1, part=right.get_part(0))
     assert cs._add_proposal_block_part(msg2, "peer-x") is True
     assert cs.rs.proposal_block_parts.count == 1
+
+
+def test_round_step_is_reannounced_without_state_change():
+    """Partition-heal liveness pin (round 5): a STUCK node must keep
+    re-announcing its round step (the message that seeds peers' catch-up
+    gossip) — broadcast-on-change alone leaves a reconnected peer's view
+    at height 0 forever."""
+    import threading
+
+    import time
+
+    from cometbft_tpu.consensus import messages as cmsg
+    from cometbft_tpu.consensus.reactor import ConsensusReactor
+
+    class FakeRS:
+        height, round, step = 7, 0, 6
+        last_commit = None
+
+    class FakeCS:
+        rs = FakeRS()
+
+        def set_broadcast(self, fn):
+            pass
+
+    class FakeSwitch:
+        def __init__(self):
+            self.sent = []
+
+        def broadcast(self, chan, data):
+            self.sent.append(cmsg.decode_consensus_message(data))
+
+    reactor = ConsensusReactor(FakeCS())
+    reactor.ROUND_STEP_REFRESH_S = 0.2
+    sw = FakeSwitch()
+    reactor.switch = sw
+    reactor._running = True
+    t = threading.Thread(target=reactor._broadcast_round_step_routine, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    reactor._running = False
+    steps = [m for m in sw.sent if isinstance(m, cmsg.NewRoundStepMessage)]
+    assert len(steps) >= 3, f"only {len(steps)} re-announcements in 1s"
+    assert all(m.height == 7 and m.step == 6 for m in steps)

@@ -169,3 +169,34 @@ def test_seed_discovery_full_mesh_consensus():
         for n in nodes:
             n.stop()
         seed.stop()
+
+
+def test_rate_limit_clock_dies_with_the_connection():
+    """Partition-heal liveness pin (round 5): a peer that disconnects and
+    reconnects within request_interval must NOT be punished for its first
+    address request — the inbound rate-limit clock is per-connection
+    (pex_reactor.go RemovePeer deletes lastReceivedRequests)."""
+    from cometbft_tpu.p2p.pex.reactor import PexReactor, encode_pex_request
+
+    book = AddrBook(strict=False, key=b"\x01" * 24)
+    r = PexReactor(book, request_interval=10.0)
+
+    class FakePeer:
+        id = "aa" * 20
+        is_outbound = False
+        remote_ip = "127.0.0.1"
+
+        class node_info:
+            listen_addr = "tcp://127.0.0.1:26656"
+
+        def try_send(self, *a):
+            return True
+
+    peer = FakePeer()
+    req = encode_pex_request()
+    r.receive(0x00, peer, req)  # first request: fine
+    with pytest.raises(ValueError, match="too often"):
+        r.receive(0x00, peer, req)  # same connection, immediate re-ask: abuse
+    r.remove_peer(peer, "conn dropped")
+    # reconnect within the interval: must be served, not punished
+    r.receive(0x00, peer, req)
