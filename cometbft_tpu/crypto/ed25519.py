@@ -151,16 +151,25 @@ _verified: dict[bytes, None] = {}
 _verified_lock = threading.Lock()
 
 
-def _verified_put(key: bytes) -> None:
-    # Writers race from multiple threads (blocksync pool routine, consensus,
-    # light client).  The insertion happens under the same lock as eviction:
-    # list(dict) while another thread inserts is only safe via the CPython
-    # GIL, and the lock is nothing next to a signature verify.
+def _verified_put_many(keys: list[bytes]) -> None:
+    """Insert verified triples under one lock acquisition (10k inserts after
+    a commit verify would otherwise take the lock 10k times).  Writers race
+    from multiple threads (blocksync pool routine, consensus, light client);
+    eviction shares the lock so list(dict) never races an insert.  The
+    oldest-quarter eviction repeats until the bound holds, so even a batch
+    larger than a quarter of the cache cannot push it past _VERIFIED_MAX."""
+    if not keys:
+        return
     with _verified_lock:
-        if len(_verified) >= _VERIFIED_MAX:
-            for k in list(_verified)[: _VERIFIED_MAX // 4]:
-                _verified.pop(k, None)
-        _verified[key] = None
+        for key in keys:
+            if len(_verified) >= _VERIFIED_MAX:
+                for k in list(_verified)[: _VERIFIED_MAX // 4]:
+                    _verified.pop(k, None)
+            _verified[key] = None
+
+
+def _verified_put(key: bytes) -> None:
+    _verified_put_many([key])
 
 
 class BatchVerifier(crypto.BatchVerifier):
@@ -205,7 +214,5 @@ class BatchVerifier(crypto.BatchVerifier):
         if all(k in _verified for k in keys):
             return True, [True] * len(keys)
         ok, bits = get_backend().batch_verify(self._pubs, self._msgs, self._sigs)
-        for k, valid in zip(keys, bits):
-            if valid:
-                _verified_put(k)
+        _verified_put_many([k for k, valid in zip(keys, bits) if valid])
         return ok, bits
