@@ -259,3 +259,50 @@ def test_round_step_is_reannounced_without_state_change():
     steps = [m for m in sw.sent if isinstance(m, cmsg.NewRoundStepMessage)]
     assert len(steps) >= 3, f"only {len(steps)} re-announcements in 1s"
     assert all(m.height == 7 and m.step == 6 for m in steps)
+
+
+def test_catchup_gossip_feeds_lagging_peer(net4):
+    """The partition-heal rescue path pinned directly: a peer one height
+    behind must receive the committed block's parts AND the seen commit's
+    precommits from _gossip_once (gossipDataForCatchup) — this is the
+    mechanism a lost round-step announcement silently disables."""
+    from cometbft_tpu.consensus import messages as cmsg
+    from cometbft_tpu.consensus.reactor import ConsensusReactor, PeerState
+
+    # drive a real network a few heights so the block store has commits
+    for cs, _, _ in net4:
+        cs.start()
+    cs0 = net4[0][0]
+    assert cs0.wait_for_height(3, timeout=30)
+    for cs, _, _ in net4:
+        cs.stop()
+
+    reactor = ConsensusReactor(cs0)
+
+    class FakePeer:
+        id = "cc" * 20
+
+        def __init__(self):
+            self.sent = []
+
+        def try_send(self, chan, data):
+            self.sent.append(cmsg.decode_consensus_message(data))
+            return True
+
+    peer = FakePeer()
+    ps = PeerState(peer)
+    ps.height = cs0.rs.height - 1  # one behind: the wedge shape
+    ps.round = 0
+    advanced = reactor._gossip_once(ps)
+    assert advanced, "catch-up gossip sent nothing to a lagging peer"
+    parts = [m for m in peer.sent if isinstance(m, cmsg.BlockPartMessage)]
+    votes = [m for m in peer.sent if isinstance(m, cmsg.VoteMessage)]
+    assert parts, "no committed block parts sent"
+    assert votes, "no seen-commit precommits sent"
+    assert all(m.height == ps.height for m in parts)
+    assert all(v.vote.height == ps.height for v in votes)
+    # a peer whose height we never learned (lost round-step) gets nothing —
+    # the exact failure mode the 1 Hz re-announce closes
+    ps2 = PeerState(FakePeer())
+    assert ps2.height == 0
+    assert reactor._gossip_once(ps2) is False
