@@ -118,6 +118,11 @@ def _load() -> ctypes.CDLL | None:
                     ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_void_p, ctypes.c_void_p,
                 ]
+                lib.cmtpu_sha256_pack.restype = None
+                lib.cmtpu_sha256_pack.argtypes = [
+                    ctypes.c_long, ctypes.c_char_p, ctypes.c_void_p,
+                    ctypes.c_long, ctypes.c_void_p, ctypes.c_void_p,
+                ]
                 _lib = lib
             except OSError:
                 _lib = None

@@ -415,3 +415,50 @@ void cmtpu_merkle_aunts(long n, const u8 *levels, long max_depth, u8 *aunts,
         counts[i] = (int32_t)cnt;
     }
 }
+
+/* Device-path leaf packing: SHA-256-pad n messages straight into the
+ * lane-major big-endian word layout [bmax, 16, n] the TPU Merkle kernel
+ * consumes (ops/sha256_kernel.pack_messages).  The numpy path pays an
+ * 8 MB strided transpose at 64k leaves; here padding and transpose fuse
+ * in one pass, tiled so the per-tile scratch stays cache-resident and
+ * every out write is a contiguous run of lanes. */
+#include <stdlib.h>
+
+void cmtpu_sha256_pack(long n, const u8 *flat, const u64 *offs, long bmax,
+                       u32 *out, int32_t *nblocks) {
+    enum { T = 64 };
+    long tile = n < T ? n : T;
+    u8 *scratch = (u8 *)malloc((size_t)tile * (size_t)bmax * 64);
+    if (!scratch) { /* caller pre-zeroed nothing; signal via nblocks */
+        for (long i = 0; i < n; i++) nblocks[i] = -1;
+        return;
+    }
+    const long row_sz = bmax * 64;
+    for (long base = 0; base < n; base += T) {
+        long t = n - base < T ? n - base : T;
+        memset(scratch, 0, (size_t)t * row_sz);
+        for (long j = 0; j < t; j++) {
+            long i = base + j;
+            u64 len = offs[i + 1] - offs[i];
+            long nb = (long)((len + 8) / 64 + 1);
+            nblocks[i] = (int32_t)nb;
+            u8 *row = scratch + j * row_sz;
+            memcpy(row, flat + offs[i], len);
+            row[len] = 0x80;
+            u64 bits = len * 8;
+            u8 *p = row + nb * 64 - 8;
+            for (int k = 0; k < 8; k++)
+                p[k] = (u8)(bits >> (8 * (7 - k)));
+        }
+        for (long bw = 0; bw < bmax * 16; bw++) {
+            u32 *dst = out + bw * n + base;
+            const u8 *src = scratch + bw * 4;
+            for (long j = 0; j < t; j++) {
+                const u8 *q = src + j * row_sz;
+                dst[j] = ((u32)q[0] << 24) | ((u32)q[1] << 16) |
+                         ((u32)q[2] << 8) | (u32)q[3];
+            }
+        }
+    }
+    free(scratch);
+}

@@ -12,6 +12,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from cometbft_tpu import native
+
 _K = [
     0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
     0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
@@ -83,14 +85,43 @@ def compress(state, words):
 
 def pack_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
     """Host: SHA-256 pad N byte strings -> (uint32[B, 16, N] big-endian word
-    blocks, int32[N] block counts), B = max blocks over the batch. Fully
-    vectorized (one join + fancy-index scatter): at 64k messages this is the
-    per-call host cost of the device Merkle path, and the per-message Python
-    loop it replaces was ~60% of the measured steady-state root time."""
+    blocks, int32[N] block counts), B = max blocks over the batch.  The
+    native tier fuses the pad and the [N,B,16]->[B,16,N] lane transpose in
+    one tiled C pass (cmtpu_sha256_pack); the numpy fallback is fully
+    vectorized but pays an 8 MB strided transpose at 64k messages (~40 ms
+    measured against the device Merkle path's 215 ms total)."""
     n = len(msgs)
     if n == 0:
         return np.zeros((1, 16, 0), np.uint32), np.zeros(0, np.int32)
     lens = np.fromiter((len(m) for m in msgs), np.int64, n)
+    lib = native.ready()
+    if lib is None:
+        native.ensure_built_async()
+    else:
+        bmax = int((int(lens.max()) + 8) // 64 + 1)
+        offs = np.zeros(n + 1, np.uint64)
+        np.cumsum(lens, out=offs[1:])
+        out = np.empty((bmax, 16, n), np.uint32)
+        nblocks = np.empty(n, np.int32)
+        lib.cmtpu_sha256_pack(
+            n,
+            b"".join(msgs),
+            offs.ctypes.data,
+            bmax,
+            out.ctypes.data,
+            nblocks.ctypes.data,
+        )
+        if nblocks[0] != -1:  # -1 = allocation failure; fall through
+            return out, nblocks
+    return _pack_messages_np(msgs, lens)
+
+
+def _pack_messages_np(
+    msgs: list[bytes], lens: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy fallback for pack_messages (also the test anchor for the C
+    path): one join + fancy-index scatter + strided lane transpose."""
+    n = len(msgs)
     nblocks = ((lens + 8) // 64 + 1).astype(np.int32)
     bmax = int(nblocks.max())
     buf = np.zeros((n, bmax * 64), np.uint8)

@@ -158,3 +158,27 @@ def test_cpu_backend_uses_native_with_exact_bitmap():
     ok, bits = CpuBackend().batch_verify(pubs, msgs, sigs)
     assert not ok
     assert bits == [i != 5 for i in range(32)]
+
+
+def test_sha256_pack_matches_numpy():
+    """The C leaf packer (cmtpu_sha256_pack) is bit-exact with the numpy
+    path across block-boundary lengths, zero-length messages, and tile
+    edges (the C pass transposes in 64-lane tiles)."""
+    import numpy as np
+
+    from cometbft_tpu.ops import sha256_kernel as sha
+
+    rng = random.Random(7)
+    boundary = [0, 1, 55, 56, 63, 64, 65, 119, 120, 128, 200]
+    cases = [
+        [b""],
+        [os.urandom(n) for n in boundary],
+        # 3 tiles + a ragged tail, mixed lengths crossing block counts
+        [os.urandom(rng.choice(boundary)) for _ in range(64 * 3 + 17)],
+    ]
+    for msgs in cases:
+        lens = np.fromiter((len(m) for m in msgs), np.int64, len(msgs))
+        want_blocks, want_nb = sha._pack_messages_np(msgs, lens)
+        got_blocks, got_nb = sha.pack_messages(msgs)
+        assert np.array_equal(want_nb, got_nb)
+        assert np.array_equal(want_blocks, got_blocks)
