@@ -180,6 +180,17 @@ def _devnet_throughput(seconds: float = 12.0, n_vals: int = 4):
                 pass
 
 
+def _pick_headline(stages: dict) -> float:
+    """Headline = fastest measured combined path; records which one won so
+    the JSON schema is identical for full and truncated emits."""
+    headline = stages["combined_ms"]
+    stages["combined_path"] = "device"
+    hyb = stages.get("combined_hybrid_ms")
+    if hyb is not None and hyb < headline:
+        headline, stages["combined_path"] = hyb, "hybrid"
+    return headline
+
+
 def best_of(f, reps=3):
     """Best wall time over reps calls, in ms."""
     best = float("inf")
@@ -437,12 +448,43 @@ def tpu_worker() -> None:
             snap["truncated"] = True
             plog("stage budget exhausted mid-stage; emitting partial result")
             try:
-                emit(snap["combined_ms"], snap, devs[0].platform)
+                emit(_pick_headline(snap), snap, devs[0].platform)
             except BaseException:
                 pass
             os._exit(0)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+
+    # ---- hybrid tier: device share in flight + host MSM + SHA-NI merkle --
+    # The candidate headline: split the 10,240-sig batch at the rate-model
+    # point (device bucket lanes async, native Pippenger MSM on the rest in
+    # this thread, SHA-NI merkle under the device wait), merge bitmaps.
+    if budget_left():
+        try:
+            from cometbft_tpu import native as _native
+            from cometbft_tpu.sidecar import backend as _be
+
+            if _native.available():
+                hb = _be.HybridBackend()
+
+                def run_hybrid():
+                    (hok, _bits), hroot = hb.verify_and_root(pubs, msgs, sigs, txs)
+                    return hok, hroot
+
+                hok, hroot = run_hybrid()  # first call pays the share-bucket compile
+                assert hok, "hybrid batch must verify"
+                assert hroot == want_root, "hybrid root != host root"
+                stages["combined_hybrid_ms"] = round(best_of(run_hybrid), 3)
+                stages["hybrid_device_share"] = hb.last_share
+                plog(
+                    f"hybrid combined {stages['combined_hybrid_ms']} ms "
+                    f"(device share {stages['hybrid_device_share']}, "
+                    f"rates d={hb._dev_rate:.0f}/h={hb._host_rate:.0f} sigs/ms)"
+                )
+            else:
+                plog("hybrid stage skipped: native tier unavailable")
+        except Exception as e:
+            plog(f"hybrid stage failed: {type(e).__name__}: {e}")
 
     # ---- stage splits ----
     if budget_left():
@@ -465,23 +507,46 @@ def tpu_worker() -> None:
             plog(f"merkle split failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail: inclusion proofs for every tx (proof.go:35) ----
+    # Shipped path (proofs_from_byte_slices routes to the native SHA-NI
+    # one-pass tree at this scale) is the headline; the device levels+aunts
+    # program stays as a diagnostic of the on-device path.
+    if budget_left():
+        try:
+            from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
+
+            stages["merkle_proofs_ms"] = round(
+                best_of(lambda: proofs_from_byte_slices(txs), reps=2), 1
+            )
+            plog(f"proofs (shipped path): {stages['merkle_proofs_ms']} ms")
+        except Exception as e:
+            plog(f"proofs stage failed: {type(e).__name__}: {e}")
     if budget_left():
         try:
             mk.proofs_aunts_device(txs)  # warm the all-levels program
-            stages["merkle_proofs_ms"] = round(
+            stages["merkle_proofs_device_ms"] = round(
                 best_of(lambda: mk.proofs_aunts_device(txs), reps=2), 1
             )
-            plog(f"proofs (device levels + aunts): {stages['merkle_proofs_ms']} ms")
+            plog(
+                f"proofs (device levels + aunts): "
+                f"{stages['merkle_proofs_device_ms']} ms"
+            )
         except Exception as e:
-            plog(f"proofs stage failed: {type(e).__name__}: {e}")
+            plog(f"device proofs stage failed: {type(e).__name__}: {e}")
 
-    # ---- shipped-path configs (BASELINE #2/#4/#5) over the device backend --
-    shipped_path_stages(stages, plog, budget_left, backend="tpu")
+    # ---- shipped-path configs (BASELINE #2/#4/#5) over the shipped
+    # backend: hybrid when the native tier built, device-only otherwise ----
+    try:
+        from cometbft_tpu import native as _native2
+
+        ship = "hybrid" if _native2.available() else "tpu"
+    except Exception:
+        ship = "tpu"
+    shipped_path_stages(stages, plog, budget_left, backend=ship)
 
     plog(f"done on {devs[0].platform}")
     with emit_once:
         finished.set()
-    emit(stages["combined_ms"], stages, devs[0].platform)
+    emit(_pick_headline(stages), stages, devs[0].platform)
 
 
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:

@@ -1,0 +1,134 @@
+"""HybridBackend: concurrent device+host split of one verification batch.
+
+The hybrid tier is this framework's answer to owning both an accelerator
+and host SIMD at once — the reference's batch verifier is single-tier
+(crypto/ed25519/ed25519.go:196-228). These tests run the real split on the
+XLA:CPU "device" + the native C MSM: the bitmap contract must hold exactly
+across the split boundary, small batches must route host-side, and a
+missing native tier must fall back to the device path.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cometbft_tpu import native
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.sidecar import backend as be
+
+
+def _batch(n, tag=b"hyb"):
+    pvs = [ed25519.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    pubs = [pv.pub_key().bytes() for pv in pvs]
+    msgs = [b"hybrid-msg-%d" % i for i in range(n)]
+    sigs = [pv.sign(m) for pv, m in zip(pvs, msgs)]
+    return pubs, msgs, sigs
+
+
+def _hybrid(monkeypatch, min_split=8, dev_rate=1000.0, host_rate=1000.0):
+    monkeypatch.setenv("CMTPU_HYBRID_MIN", str(min_split))
+    monkeypatch.setenv("CMTPU_DEV_RATE", str(dev_rate))
+    monkeypatch.setenv("CMTPU_HOST_RATE", str(host_rate))
+    monkeypatch.setenv("CMTPU_DEV_OVERHEAD_MS", "0")
+    return be.HybridBackend()
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native tier unavailable"
+)
+
+
+@needs_native
+def test_plan_picks_interior_bucket(monkeypatch):
+    hb = _hybrid(monkeypatch)
+    # Equal rates, no overhead: n=48 should split at bucket 32 (host 16),
+    # not pad the whole batch to the 128 bucket or go all-host.
+    assert hb._plan(48) == 32
+
+
+@needs_native
+def test_split_batch_all_valid(monkeypatch):
+    hb = _hybrid(monkeypatch)
+    pubs, msgs, sigs = _batch(48)
+    ok, bits = hb.batch_verify(pubs, msgs, sigs)
+    assert ok and bits == [True] * 48
+
+
+@needs_native
+def test_split_batch_bitmap_exact_across_boundary(monkeypatch):
+    hb = _hybrid(monkeypatch)
+    pubs, msgs, sigs = _batch(48)
+    # Corrupt one signature inside the device share, one in the host share,
+    # and one message right at the split boundary (index 32).
+    bad = {3, 32, 45}
+    sigs[3] = sigs[3][:-1] + bytes([sigs[3][-1] ^ 1])
+    msgs[32] = msgs[32] + b"!"
+    sigs[45] = b"\x00" * 64
+    ok, bits = hb.batch_verify(pubs, msgs, sigs)
+    assert not ok
+    assert [i for i, b in enumerate(bits) if not b] == sorted(bad)
+
+
+@needs_native
+def test_small_batch_routes_host(monkeypatch):
+    hb = _hybrid(monkeypatch, min_split=64)
+    hb._tpu.batch_verify = lambda *a: pytest.fail("device tier must not run")
+    pubs, msgs, sigs = _batch(24)
+    ok, bits = hb.batch_verify(pubs, msgs, sigs)
+    assert ok and all(bits)
+
+
+def test_native_missing_falls_back_to_device(monkeypatch):
+    hb = _hybrid(monkeypatch)
+
+    class _NoNative:
+        @staticmethod
+        def ready():
+            return None
+
+        @staticmethod
+        def ensure_built_async():
+            pass
+
+    hb._native = _NoNative()
+    called = {}
+
+    def _fake_dev(p, m, s):
+        called["n"] = len(p)
+        return True, [True] * len(p)
+
+    hb._tpu.batch_verify = _fake_dev
+    pubs, msgs, sigs = _batch(12)
+    ok, _ = hb.batch_verify(pubs, msgs, sigs)
+    assert ok and called["n"] == 12
+
+
+@needs_native
+def test_verify_and_root_overlap(monkeypatch):
+    from cometbft_tpu.crypto.merkle import hash_from_byte_slices
+
+    hb = _hybrid(monkeypatch)
+    pubs, msgs, sigs = _batch(48)
+    leaves = [b"leaf-%d" % i for i in range(100)]
+    (ok, bits), root = hb.verify_and_root(pubs, msgs, sigs, leaves)
+    assert ok and all(bits)
+    assert root == hash_from_byte_slices(leaves)
+
+
+@needs_native
+def test_rate_ema_stays_clamped(monkeypatch):
+    hb = _hybrid(monkeypatch)
+    pubs, msgs, sigs = _batch(48)
+    for _ in range(3):
+        hb.batch_verify(pubs, msgs, sigs)
+    assert 5.0 <= hb._dev_rate <= 5000.0
+    assert 5.0 <= hb._host_rate <= 5000.0
+
+
+def test_backend_env_selects_hybrid(monkeypatch):
+    monkeypatch.setenv("CMTPU_BACKEND", "hybrid")
+    be.set_backend(None)
+    try:
+        assert be.get_backend().name == "hybrid"
+    finally:
+        be.set_backend(None)
