@@ -29,6 +29,9 @@ from __future__ import annotations
 import functools
 import hashlib
 import os
+import queue
+import threading
+from concurrent.futures import Future
 
 import numpy as np
 
@@ -287,7 +290,7 @@ def pack_batch(pubs, msgs, sigs):
 
 
 _device_pool = None
-_device_pool_lock = __import__("threading").Lock()
+_device_pool_lock = threading.Lock()
 
 
 class _DeviceOwner:
@@ -299,9 +302,6 @@ class _DeviceOwner:
     hang process shutdown forever."""
 
     def __init__(self):
-        import queue
-        import threading
-
         self._q = queue.Queue()
         t = threading.Thread(target=self._run, name="cmtpu-dev", daemon=True)
         t.start()
@@ -317,8 +317,6 @@ class _DeviceOwner:
                 fut.set_exception(e)
 
     def submit(self, fn):
-        from concurrent.futures import Future
-
         fut = Future()
         self._q.put((fn, fut))
         return fut
@@ -340,7 +338,8 @@ def batch_verify_submit(pubs, msgs, sigs):
     blocking behavior just collect immediately (batch_verify below)."""
     n = len(pubs)
     operands, host_ok = pack_batch(pubs, msgs, sigs)
-    fn = _compiled(*_bucket_key(operands))
+    key = _bucket_key(operands)
+    fn = _compiled(*key)
     fut = _pool().submit(lambda: np.asarray(fn(*operands)))
 
     def collect() -> tuple[bool, list]:
@@ -348,6 +347,9 @@ def batch_verify_submit(pubs, msgs, sigs):
         results = [bool(host_ok[i] and dev_ok[i]) for i in range(n)]
         return all(results), results
 
+    # (batch bucket, block bucket) — the compiled-program identity, so
+    # callers can tell a first dispatch (XLA compile) from a steady one.
+    collect.program_key = key
     return collect
 
 

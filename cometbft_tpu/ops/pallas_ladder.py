@@ -165,32 +165,19 @@ def _ladder_math(s_dig, k_dig, ax, ay, az, at, n_windows=None):
         [r[i] for i in range(fe.LIMBS)] for r in (ax, ay, az, at)
     )
 
-    # per-lane [1..8]A table in precomp form, built by a ROLLED chain of
-    # additions (edwards.build_table_pre does the same for the same
-    # reason: one compiled add body, not 7 inlined ~10k-op point ops —
-    # trace/compile size is the whole game for this kernel)
+    # per-lane [1..8]A table in precomp form. The chain is UNROLLED in
+    # python: the rolled fori_loop form needed `tbl.at[i].set(...)` with a
+    # traced index, which jnp lowers to `scatter` — a primitive Mosaic's TC
+    # kernel lowering does not implement (measured on device, tpu_ab.log
+    # round 5). Seven inlined point adds cost trace size, but inside ONE
+    # Mosaic kernel the XLA whole-graph compile ceiling that forced the
+    # rolled form on the stacked path does not apply.
     pp = _to_precomp(a_point)
-    pp_stacked = tuple(jnp.stack(c) for c in pp)
-    cur0 = tuple(jnp.stack(c) for c in a_point)
-    tbl0 = jnp.zeros((8, 4, fe.LIMBS) + pp_stacked[0].shape[1:], jnp.int32)
-    tbl0 = tbl0.at[0].set(jnp.stack(pp_stacked))
-
-    def tbl_body(i, carry):
-        tbl, cur = carry
-        cur_rows = tuple([c[k] for k in range(fe.LIMBS)] for c in cur)
-        nxt = _add_precomp(cur_rows, pp, z2_is_one=False)
-        nxt_pre = _to_precomp(nxt)
-        tbl = tbl.at[i].set(
-            jnp.stack([jnp.stack(list(c)) for c in nxt_pre])
-        )
-        return tbl, tuple(jnp.stack(list(c)) for c in nxt)
-
-    tbl_arr, _ = lax.fori_loop(1, 8, tbl_body, (tbl0, cur0))
-    # back to the row-tree shape _select_a wants: table[e][coord][limb]
-    table = [
-        [[tbl_arr[e, c, i] for i in range(fe.LIMBS)] for c in range(4)]
-        for e in range(8)
-    ]
+    table = [pp]
+    cur = a_point
+    for _ in range(7):
+        cur = _add_precomp(cur, pp, z2_is_one=False)
+        table.append(_to_precomp(cur))
 
     t = s_dig.shape[1]
     zero = jnp.zeros((t,), jnp.int32)

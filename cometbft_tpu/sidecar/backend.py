@@ -7,8 +7,9 @@ Selection (env `CMTPU_BACKEND`, default `auto`):
               bucket-aligned split of each large batch, small batches routed
               to whichever tier's cost model wins
   - `grpc`:   remote verification sidecar over gRPC (sidecar/service.py)
-  - `auto`:   `hybrid` when a JAX accelerator AND the native library are
-              available, `tpu` with only an accelerator, else `cpu`
+  - `auto`:   `hybrid` whenever a JAX accelerator is visible (it degrades
+              per-call to device-only until/unless the native library
+              builds, so selection never blocks on gcc), else `cpu`
 
 This mirrors where the reference chooses batch vs single verification
 (types/validation.go:14-16, 43-50): the caller keeps its fallback path, the
@@ -138,10 +139,11 @@ class HybridBackend(VerifyBackend):
         self._dev_overhead = float(os.environ.get("CMTPU_DEV_OVERHEAD_MS", "8"))
         self._min_split = int(os.environ.get("CMTPU_HYBRID_MIN", "2048"))
         self._rate_lock = threading.Lock()
-        # Device buckets whose program has already run once in this process:
-        # the first dispatch of a bucket can pay a multi-second XLA compile,
-        # which must not be charged to the steady-state rate model.
-        self._warmed: set[int] = set()
+        # Compiled-program keys (batch bucket, block bucket) that have
+        # already run once in this process: the first dispatch of a program
+        # can pay a multi-second XLA compile, which must not be charged to
+        # the steady-state rate model.
+        self._warmed: set[tuple] = set()
         # Share used by the most recent split call (observability; bench).
         self.last_share = 0
 
@@ -192,10 +194,12 @@ class HybridBackend(VerifyBackend):
         t_host = time.perf_counter()
         ok_d, bits_d = collect()
         t_dev = time.perf_counter()
-        self._update_rates(share, n - share, t0, t_disp, t_host, t_host, t_dev)
+        self._update_rates(
+            collect.program_key, share, n - share, t0, t_disp, t_host, t_host, t_dev
+        )
         return ok_d and ok_h, bits_d + bits_h
 
-    def _update_rates(self, n_dev, n_host, t0, t_disp, t_host, t_wait, t_dev):
+    def _update_rates(self, key, n_dev, n_host, t0, t_disp, t_host, t_wait, t_dev):
         """EMA the rate model from what this call actually measured. The
         host share ran exclusively in [t_disp, t_host]. The device wall is
         only observable when the device was the straggler (collect(),
@@ -207,8 +211,8 @@ class HybridBackend(VerifyBackend):
         alpha = 0.3
         host_ms = (t_host - t_disp) * 1000
         dev_ms = (t_dev - t0) * 1000
-        first_use = n_dev not in self._warmed
-        self._warmed.add(n_dev)
+        first_use = key not in self._warmed
+        self._warmed.add(key)
         with self._rate_lock:
             if host_ms > 1:
                 r = min(max(n_host / host_ms, 5.0), 5000.0)
@@ -231,9 +235,9 @@ class HybridBackend(VerifyBackend):
         share = 0
         if n >= self._min_split and self._native.ready() is not None:
             share = min(self._plan(n), n)
-        if 0 < share < n:
-            from cometbft_tpu.ops import ed25519_kernel as ek
+        from cometbft_tpu.ops import ed25519_kernel as ek
 
+        if 0 < share < n:
             self.last_share = share
             t0 = time.perf_counter()
             collect = ek.batch_verify_submit(
@@ -248,8 +252,18 @@ class HybridBackend(VerifyBackend):
             t_wait = time.perf_counter()
             ok_d, bits_d = collect()
             t_dev = time.perf_counter()
-            self._update_rates(share, n - share, t0, t_disp, t_host, t_wait, t_dev)
+            self._update_rates(
+                collect.program_key, share, n - share, t0, t_disp, t_host,
+                t_wait, t_dev,
+            )
             return (ok_d and ok_h, bits_d + bits_h), root
+        if share >= n > 0:
+            # All-device plan: still overlap the host merkle with the
+            # device wait instead of serializing it after a blocking verify.
+            self.last_share = n
+            collect = ek.batch_verify_submit(pubs, msgs, sigs)
+            root = self.merkle_root(leaves)
+            return collect(), root
         ok, bits = self.batch_verify(pubs, msgs, sigs)
         return (ok, bits), self.merkle_root(leaves)
 
