@@ -144,8 +144,10 @@ class HybridBackend(VerifyBackend):
         # can pay a multi-second XLA compile, which must not be charged to
         # the steady-state rate model.
         self._warmed: set[tuple] = set()
-        # Share used by the most recent split call (observability; bench).
+        # Share + stage walls of the most recent split call (observability;
+        # bench reports these so device runs explain themselves).
         self.last_share = 0
+        self.last_timing: dict = {}
 
     def _plan(self, n: int) -> int:
         """Device share (a bucket size, possibly 0=all-host or >=n=all-device)
@@ -213,6 +215,17 @@ class HybridBackend(VerifyBackend):
         dev_ms = (t_dev - t0) * 1000
         first_use = key not in self._warmed
         self._warmed.add(key)
+        self.last_timing = {
+            "n_dev": n_dev,
+            "n_host": n_host,
+            "pack_dispatch_ms": round((t_disp - t0) * 1000, 2),
+            "host_msm_ms": round(host_ms, 2),
+            "overlap_extra_ms": round((t_wait - t_host) * 1000, 2),
+            "dev_wait_ms": round((t_dev - t_wait) * 1000, 2),
+            "dev_wall_ms": round(dev_ms, 2),
+            "total_ms": round((t_dev - t0) * 1000, 2),
+            "first_use": first_use,
+        }
         with self._rate_lock:
             if host_ms > 1:
                 r = min(max(n_host / host_ms, 5.0), 5000.0)
