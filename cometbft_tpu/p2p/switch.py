@@ -3,17 +3,37 @@
 Reactors register channel descriptors; inbound/outbound peers get an
 MConnection whose receive callback dispatches to the owning reactor.
 Broadcast fan-outs TrySend to every peer (switch.go:271). Persistent peers
-are redialed with exponential backoff (switch.go:474+).
+are redialed on a two-phase schedule (switch.go:474+ reconnectToPeer:
+quick linear attempts, then exponential backoff).
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from cometbft_tpu.p2p.node_info import NodeInfo
 from cometbft_tpu.p2p.transport import MultiplexTransport, UpgradedConn
+
+# Redial schedule (switch.go reconnectToPeer shape): 20 linear attempts at
+# ~1 s, then exponential doubling capped at 60 s, all with +/-20% jitter.
+REDIAL_LINEAR_ATTEMPTS = 20
+REDIAL_LINEAR_SLEEP_S = 1.0
+REDIAL_MAX_SLEEP_S = 60.0
+
+
+def redial_delay(attempt: int) -> float:
+    """Seconds to wait before redial `attempt` (1-based)."""
+    if attempt <= REDIAL_LINEAR_ATTEMPTS:
+        base = REDIAL_LINEAR_SLEEP_S
+    else:
+        base = min(
+            REDIAL_LINEAR_SLEEP_S * 2.0 ** (attempt - REDIAL_LINEAR_ATTEMPTS),
+            REDIAL_MAX_SLEEP_S,
+        )
+    return base * (0.8 + 0.4 * random.random())
 
 
 class Peer:
@@ -165,21 +185,26 @@ class Switch:
         self._persistent_addrs.extend(a for a in addrs if a)
 
     def dial_persistent_peers(self) -> None:
-        """Exponential-backoff redial loop (switch.go reconnectToPeer)."""
+        """Two-phase redial loop (switch.go reconnectToPeer): a burst of
+        quick linear attempts first — a healed partition reconnects in
+        seconds instead of waiting out a grown exponential backoff — then
+        exponential growth to a 60 s cap for genuinely-gone peers. Jitter
+        keeps a rebooted validator set from dialing in lockstep."""
 
         def redial(addr):
-            backoff = 1.0
+            attempt = 0
             while self._running:
                 expected_id = addr.split("@", 1)[0] if "@" in addr else ""
                 if expected_id and self.get_peer(expected_id) is not None:
+                    attempt = 0
                     time.sleep(5)
                     continue
                 try:
                     self.dial_peer(addr)
-                    backoff = 1.0
+                    attempt = 0
                 except Exception:
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 60.0)
+                    attempt += 1
+                    time.sleep(redial_delay(attempt))
 
         for addr in self._persistent_addrs:
             threading.Thread(target=redial, args=(addr,), daemon=True).start()

@@ -144,6 +144,11 @@ class HybridBackend(VerifyBackend):
         # can pay a multi-second XLA compile, which must not be charged to
         # the steady-state rate model.
         self._warmed: set[tuple] = set()
+        # Measured device wall per batch bucket (EMA, straggler-observed
+        # only). The device cost is AFFINE — tens of ms of fixed tunnel +
+        # dispatch latency plus a per-lane slope — so a single sigs/ms rate
+        # learned at one bucket misprices every other; real walls win.
+        self._dev_wall: dict[int, float] = {}
         # Share + stage walls of the most recent split call (observability;
         # bench reports these so device runs explain themselves).
         self.last_share = 0
@@ -155,7 +160,25 @@ class HybridBackend(VerifyBackend):
         from cometbft_tpu.ops import ed25519_kernel as ek
 
         def dev_ms(b):  # padded lanes compute like real ones
-            return ek.bucket_for(b) / self._dev_rate + self._dev_overhead
+            bucket = ek.bucket_for(b)
+            wall = self._dev_wall.get(bucket)
+            if wall is not None:
+                return wall
+            obs = sorted(self._dev_wall.items())
+            if len(obs) >= 2:
+                # affine fit over the widest observed span
+                (b1, w1), (b2, w2) = obs[0], obs[-1]
+                slope = max((w2 - w1) / (b2 - b1), 0.0)
+                return max(w1 + slope * (bucket - b1), 1.0)
+            if len(obs) == 1:
+                b1, w1 = obs[0]
+                if bucket > b1:
+                    return w1 + (bucket - b1) / self._dev_rate
+                # smaller buckets still pay the fixed dispatch floor
+                return max(
+                    w1 - (b1 - bucket) / self._dev_rate, self._dev_overhead
+                )
+            return bucket / self._dev_rate + self._dev_overhead
 
         def host_ms(k):
             return k / self._host_rate
@@ -234,6 +257,9 @@ class HybridBackend(VerifyBackend):
             if straggler and not first_use and dev_ms > self._dev_overhead:
                 r = min(max(n_dev / (dev_ms - self._dev_overhead), 5.0), 5000.0)
                 self._dev_rate += alpha * (r - self._dev_rate)
+                bucket = key[0]
+                prev = self._dev_wall.get(bucket, dev_ms)
+                self._dev_wall[bucket] = prev + alpha * (dev_ms - prev)
 
     def merkle_root(self, leaves):
         if self._native.ready() is not None:

@@ -298,3 +298,18 @@ def test_fuzzed_drop_connection_reconnects():
     finally:
         sw_a.stop()
         sw_b.stop()
+
+
+def test_redial_delay_two_phase():
+    """Healed partitions must reconnect in seconds: linear phase stays ~1 s
+    for 20 attempts, then doubles to a 60 s cap (switch.go reconnectToPeer
+    shape); jitter stays within +/-20%."""
+    from cometbft_tpu.p2p.switch import redial_delay
+
+    for attempt in range(1, 21):
+        assert 0.8 <= redial_delay(attempt) <= 1.2
+    assert 1.6 <= redial_delay(21) <= 2.4
+    assert 3.2 <= redial_delay(22) <= 4.8
+    for attempt in (26, 30, 100):
+        assert redial_delay(attempt) <= 60.0 * 1.2
+    assert redial_delay(40) >= 60.0 * 0.8
