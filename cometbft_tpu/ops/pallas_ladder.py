@@ -196,8 +196,15 @@ def _ladder_math(s_dig, k_dig, ax, ay, az, at, n_windows=None):
             0, ed.WINDOW_BITS,
             lambda _, a: tuple(tuple(c) for c in _pdbl(a)), acc,
         )
-        kd = lax.dynamic_index_in_dim(k_dig, row, 0, keepdims=False)
-        sd = lax.dynamic_index_in_dim(s_dig, row, 0, keepdims=False)
+        # Digit-row fetch as a one-hot masked reduction: Mosaic's TC
+        # lowering implements neither `scatter` nor `dynamic_slice`
+        # (both measured on device, round-5 A/B), and a [DIGITS, T]
+        # mask-multiply-sum per window is noise next to the point math.
+        sel = (
+            lax.broadcasted_iota(jnp.int32, (ed.DIGITS, 1), 0) == row
+        ).astype(jnp.int32)
+        kd = jnp.sum(k_dig * sel, axis=0)
+        sd = jnp.sum(s_dig * sel, axis=0)
         acc = _add_precomp(acc, _select_a(table, kd), z2_is_one=False)
         acc = _add_precomp(acc, _select_b(sd), z2_is_one=True)
         # normalize to the carry treedef (tuples, not the lists the row

@@ -149,6 +149,12 @@ class HybridBackend(VerifyBackend):
         # dispatch latency plus a per-lane slope — so a single sigs/ms rate
         # learned at one bucket misprices every other; real walls win.
         self._dev_wall: dict[int, float] = {}
+        # Hill-climb bias on the bucket ladder: when the device finishes
+        # early its true wall is unobservable (collect() never blocks), so
+        # the rate model alone can NEVER learn to grow the device share —
+        # the controller shifts the split one bucket toward whichever tier
+        # sat idle, bounded so a broken model can't run away.
+        self._bias = 0
         # Share + stage walls of the most recent split call (observability;
         # bench reports these so device runs explain themselves).
         self.last_share = 0
@@ -183,11 +189,15 @@ class HybridBackend(VerifyBackend):
         def host_ms(k):
             return k / self._host_rate
 
+        ladder = [*[b for b in ek.BUCKETS if b < n], n]
         best_b, best_cost = 0, host_ms(n)
-        for b in (*[b for b in ek.BUCKETS if b < n], n):
+        for b in ladder:
             cost = max(dev_ms(b), host_ms(n - b))
             if cost < best_cost:
                 best_b, best_cost = b, cost
+        if best_b > 0 and self._bias:
+            i = ladder.index(best_b) + self._bias
+            best_b = ladder[max(0, min(i, len(ladder) - 1))]
         return best_b
 
     def batch_verify(self, pubs, msgs, sigs):
@@ -199,15 +209,34 @@ class HybridBackend(VerifyBackend):
             # the device alone beats the sequential-OpenSSL fallback.
             return self._tpu.batch_verify(pubs, msgs, sigs)
         if n < self._min_split:
-            share = 0
-        else:
-            share = self._plan(n)
-        if share <= 0:
+            # Tiny batches carry no useful rate signal and must not decay
+            # the bias learned on commit-sized ones.
             return self._cpu.batch_verify(pubs, msgs, sigs)
-        if share >= n:
-            return self._tpu.batch_verify(pubs, msgs, sigs)
-
+        share = self._plan(n)
         from cometbft_tpu.ops import ed25519_kernel as ek
+
+        if share <= 0:
+            self.last_share = 0
+            t0 = time.perf_counter()
+            res = self._cpu.batch_verify(pubs, msgs, sigs)
+            host_ms = (time.perf_counter() - t0) * 1000
+            with self._rate_lock:
+                if host_ms > 1:
+                    r = min(max(n / host_ms, 5.0), 5000.0)
+                    self._host_rate += 0.3 * (r - self._host_rate)
+                self._decay_bias()
+            return res
+        if share >= n:
+            self.last_share = n
+            t0 = time.perf_counter()
+            collect = ek.batch_verify_submit(pubs, msgs, sigs)
+            t_disp = time.perf_counter()
+            res = collect()
+            t_dev = time.perf_counter()
+            self._update_rates(
+                collect.program_key, n, 0, t0, t_disp, t_disp, t_disp, t_dev
+            )
+            return res
 
         self.last_share = share
         t0 = time.perf_counter()
@@ -248,6 +277,7 @@ class HybridBackend(VerifyBackend):
             "dev_wall_ms": round(dev_ms, 2),
             "total_ms": round((t_dev - t0) * 1000, 2),
             "first_use": first_use,
+            "bias": self._bias,
         }
         with self._rate_lock:
             if host_ms > 1:
@@ -260,6 +290,26 @@ class HybridBackend(VerifyBackend):
                 bucket = key[0]
                 prev = self._dev_wall.get(bucket, dev_ms)
                 self._dev_wall[bucket] = prev + alpha * (dev_ms - prev)
+            if not first_use:
+                wait_ms = (t_dev - t_wait) * 1000
+                if n_host == 0:
+                    # All-device/all-host calls carry no idle-tier signal;
+                    # decay toward the model's choice so neither extreme is
+                    # an absorbing state (the split paths stop updating the
+                    # moment the backend stops splitting).
+                    self._decay_bias()
+                elif not straggler:
+                    # device idle at collect: give it one bucket more
+                    self._bias = min(self._bias + 1, 3)
+                elif wait_ms > 0.2 * max(dev_ms, 1.0):
+                    # device clearly the straggler: pull one bucket back
+                    self._bias = max(self._bias - 1, -3)
+
+    def _decay_bias(self):
+        if self._bias > 0:
+            self._bias -= 1
+        elif self._bias < 0:
+            self._bias += 1
 
     def merkle_root(self, leaves):
         if self._native.ready() is not None:
@@ -300,9 +350,17 @@ class HybridBackend(VerifyBackend):
             # All-device plan: still overlap the host merkle with the
             # device wait instead of serializing it after a blocking verify.
             self.last_share = n
+            t0 = time.perf_counter()
             collect = ek.batch_verify_submit(pubs, msgs, sigs)
+            t_disp = time.perf_counter()
             root = self.merkle_root(leaves)
-            return collect(), root
+            t_wait = time.perf_counter()
+            res = collect()
+            t_dev = time.perf_counter()
+            self._update_rates(
+                collect.program_key, n, 0, t0, t_disp, t_disp, t_wait, t_dev
+            )
+            return res, root
         ok, bits = self.batch_verify(pubs, msgs, sigs)
         return (ok, bits), self.merkle_root(leaves)
 
