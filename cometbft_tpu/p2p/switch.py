@@ -162,7 +162,19 @@ class Switch:
             reactor.init_peer(peer)
         peer.start()
         with self._mtx:
-            self._peers[peer.id] = peer
+            # Re-check at insert: a simultaneous cross-dial (inbound accept
+            # + outbound dial, same id) passes the pre-upgrade duplicate
+            # check in both threads; overwriting here would displace a peer
+            # that reactors were told about and that stop_peer_for_error's
+            # instance check would then never clean up.
+            if up.peer_id in self._peers:
+                dup = True
+            else:
+                self._peers[peer.id] = peer
+                dup = False
+        if dup:
+            peer.stop()
+            return
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
 
@@ -224,10 +236,20 @@ class Switch:
             if isinstance(reason, Exception):
                 traceback.print_exception(reason, file=sys.stderr)
         with self._mtx:
-            existing = self._peers.pop(peer.id, None)
-        if existing is None:
-            return
+            existing = self._peers.get(peer.id)
+            if existing is peer:
+                del self._peers[peer.id]
+        # Always stop THIS instance's threads, but only the instance that
+        # owns the table entry may tear down reactor state: a dead
+        # connection errors from both its send and recv routines, and with
+        # fast redial the replacement peer (same id) can already be live
+        # when the second error fires — removing BY ID here evicted the
+        # replacement, killed its gossip threads, and left a ghost TCP conn
+        # that made the remote reject every subsequent redial as a
+        # duplicate. That was the partition-heal wedge.
         peer.stop()
+        if existing is not peer:
+            return
         for reactor in self.reactors.values():
             reactor.remove_peer(peer, reason)
 

@@ -313,3 +313,58 @@ def test_redial_delay_two_phase():
     for attempt in (26, 30, 100):
         assert redial_delay(attempt) <= 60.0 * 1.2
     assert redial_delay(40) >= 60.0 * 0.8
+
+
+def test_stale_peer_error_does_not_evict_replacement():
+    """The partition-heal wedge (round 5): a dead connection errors from
+    both its send and recv routines; if a replacement peer (same id) is
+    already live when the late error fires, stop_peer_for_error must stop
+    only the stale instance — evicting the replacement by id killed its
+    gossip state and left a ghost conn the remote kept treating as live."""
+
+    class Recorder(EchoReactor):
+        def __init__(self, chan):
+            super().__init__(chan)
+            self.removed = []
+
+        def remove_peer(self, peer, reason):
+            self.removed.append(peer)
+
+    sw1, _ = _make_switch("n1")
+    sw2, nk2 = _make_switch("n2")
+    r1 = Recorder(0x77)
+    r2 = EchoReactor(0x77)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    addr2 = sw2.start("127.0.0.1:0")
+    sw1.start("")
+    try:
+        old = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        assert old is not None
+        # Simulate the reconnect completing before the old conn's second
+        # error routine fires: remove old from the table the normal way,
+        # then dial a fresh instance under the same id. sw2 must have
+        # noticed the old conn's death first, or it will reject the redial
+        # as a duplicate id.
+        sw1.stop_peer_for_error(old, "first error (recv routine)")
+        assert sw1.get_peer(nk2.id) is None
+        assert r1.removed == [old]
+        for _ in range(100):
+            if sw2.num_peers() == 0:
+                break
+            time.sleep(0.05)
+        assert sw2.num_peers() == 0
+        replacement = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        assert replacement is not None and replacement is not old
+        # The stale instance's OTHER error routine fires late.
+        sw1.stop_peer_for_error(old, "second error (send routine)")
+        # The replacement must still own the table entry, its reactor
+        # state must be untouched, and its transport must actually deliver.
+        assert sw1.get_peer(nk2.id) is replacement
+        assert r1.removed == [old]
+        assert replacement.send(0x77, b"still-alive")
+        assert r2.event.wait(5), "replacement connection did not deliver"
+        assert r2.received[-1][1] == b"still-alive"
+    finally:
+        sw1.stop()
+        sw2.stop()
