@@ -188,12 +188,13 @@ def batch_verify(
     chal_buf = b"".join(
         sigs[i][:32] + pubs[i] + msgs[i] for i in cand
     )
+    import numpy as np
+
     offs = (ctypes.c_uint64 * (m + 1))()
-    acc = 0
-    for j, i in enumerate(cand):
-        offs[j] = acc
-        acc += 64 + len(msgs[i])
-    offs[m] = acc
+    np.cumsum(
+        np.fromiter((64 + len(msgs[i]) for i in cand), np.uint64, m),
+        out=np.frombuffer(offs, np.uint64)[1:],
+    )
     digests = ctypes.create_string_buffer(64 * m)
     lib.cmtpu_sha512_batch(m, chal_buf, offs, digests)
 
@@ -256,6 +257,21 @@ def batch_verify(
     return all(bits), bits
 
 
+def _leaf_offsets(leaves: list[bytes]):
+    """uint64[n+1] cumulative offsets as a ctypes array — vectorized; the
+    obvious python accumulation loop costs ~10 ms at 64k leaves on a small
+    host, which was a visible slice of the hybrid tier's merkle overlap."""
+    import numpy as np
+
+    n = len(leaves)
+    offs = (ctypes.c_uint64 * (n + 1))()
+    view = np.frombuffer(offs, np.uint64)
+    np.cumsum(
+        np.fromiter((len(v) for v in leaves), np.uint64, n), out=view[1:]
+    )
+    return offs
+
+
 def merkle_root(leaves: list[bytes]) -> bytes:
     """RFC-6962 root, identical to crypto/merkle hash_from_byte_slices."""
     lib = _load()
@@ -265,12 +281,7 @@ def merkle_root(leaves: list[bytes]) -> bytes:
     if n == 0:
         return hashlib.sha256(b"").digest()
     buf = b"".join(leaves)
-    offs = (ctypes.c_uint64 * (n + 1))()
-    acc = 0
-    for i, leaf in enumerate(leaves):
-        offs[i] = acc
-        acc += len(leaf)
-    offs[n] = acc
+    offs = _leaf_offsets(leaves)
     scratch = ctypes.create_string_buffer(32 * n)
     out = ctypes.create_string_buffer(32)
     lib.cmtpu_merkle_root(n, buf, offs, scratch, out)
@@ -291,12 +302,7 @@ def merkle_proof_parts(
     if n == 0:
         return hashlib.sha256(b"").digest(), [], b"", 0, []
     buf = b"".join(leaves)
-    offs = (ctypes.c_uint64 * (n + 1))()
-    acc = 0
-    for i, leaf in enumerate(leaves):
-        offs[i] = acc
-        acc += len(leaf)
-    offs[n] = acc
+    offs = _leaf_offsets(leaves)
 
     total_nodes = 0
     size = n
