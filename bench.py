@@ -54,6 +54,20 @@ BS_VALS = int(os.environ.get("CMTPU_BENCH_BS_VALS", "1024"))
 BS_BLOCKS = int(os.environ.get("CMTPU_BENCH_BS_BLOCKS", "100"))
 LIGHT_VALS = int(os.environ.get("CMTPU_BENCH_LIGHT_VALS", "4096"))
 RELAY_PORT = 8082
+# The watcher's device A/B records its adopted lowering in .tpu_fe_mode so
+# later watcher runs stick to it; honor the same decision when bench.py is
+# invoked directly (the driver's end-of-round run), explicit env winning.
+_sticky = None
+try:
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".tpu_fe_mode")) as _f:
+        _sticky = _f.read().strip() or None
+except OSError:
+    pass
+if _sticky == "pallas":
+    os.environ.setdefault("CMTPU_LADDER", "pallas")
+elif _sticky:
+    os.environ.setdefault("CMTPU_FE_MODE", _sticky)
 PROBE_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_PROBE_TIMEOUT", "120"))
 TPU_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_TPU_TIMEOUT", "480"))
 # Leave headroom before TPU_TIMEOUT_S: optional stages are skipped once the
@@ -372,6 +386,9 @@ def tpu_worker() -> None:
     from cometbft_tpu.ops import field25519 as _fe
 
     stages["fe_mode"] = _fe._mode()
+    stages["ladder"] = (
+        "pallas" if os.environ.get("CMTPU_LADDER") == "pallas" else "xla"
+    )
     if os.environ.get("CMTPU_HOST_HASH") == "1":
         stages["host_hash"] = True
 
