@@ -116,7 +116,12 @@ def val(path):
         pass
     return None
 cur, alt = val("tpu_bench_latest.json"), val("tpu_bench_alt.out")
-if alt and (cur is None or alt["value"] < cur["value"]):
+# Adoption needs more than a better headline: the alt mode's compile cost
+# must not have truncated the stage table (a late stage present proves the
+# worker finished within budget) — a mode that wins 5 ms but loses half
+# the stages is a worse round artifact.
+complete = bool(alt) and "blocksync_replay_ms_per_block" in alt.get("stages", {})
+if alt and complete and (cur is None or alt["value"] < cur["value"]):
     open("tpu_bench_latest.json", "w").write(json.dumps(alt) + "\n")
     open(".tpu_fe_mode", "w").write(os.environ["AB_BEST"] + "\n")
     print(f"[watch] alt-mode bench better ({alt['value']} ms); mode kept")
