@@ -290,15 +290,17 @@ class HybridBackend(VerifyBackend):
                 bucket = key[0]
                 prev = self._dev_wall.get(bucket, dev_ms)
                 self._dev_wall[bucket] = prev + alpha * (dev_ms - prev)
-            if not first_use:
-                wait_ms = (t_dev - t_wait) * 1000
-                if n_host == 0:
-                    # All-device/all-host calls carry no idle-tier signal;
-                    # decay toward the model's choice so neither extreme is
-                    # an absorbing state (the split paths stop updating the
-                    # moment the backend stops splitting).
-                    self._decay_bias()
-                elif not straggler:
+            wait_ms = (t_dev - t_wait) * 1000
+            if n_host == 0:
+                # All-device/all-host calls carry no idle-tier signal;
+                # decay toward the model's choice so neither extreme is
+                # an absorbing state (the split paths stop updating the
+                # moment the backend stops splitting). Decay is not a
+                # timing measurement, so first-dispatch compiles don't
+                # gate it.
+                self._decay_bias()
+            elif not first_use:
+                if not straggler:
                     # device idle at collect: give it one bucket more
                     self._bias = min(self._bias + 1, 3)
                 elif wait_ms > 0.2 * max(dev_ms, 1.0):

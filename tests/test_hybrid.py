@@ -132,3 +132,35 @@ def test_backend_env_selects_hybrid(monkeypatch):
         assert be.get_backend().name == "hybrid"
     finally:
         be.set_backend(None)
+
+
+@needs_native
+def test_all_device_path_feeds_model_and_decays_bias(monkeypatch):
+    """All-device calls must keep updating the model and decay the bias —
+    otherwise a bias-climbed all-device plan becomes an absorbing state
+    with no feedback path back to splitting."""
+    hb = _hybrid(monkeypatch, dev_rate=5000.0, host_rate=5.0)
+    hb._bias = 3
+    pubs, msgs, sigs = _batch(48)
+    assert hb._plan(48) >= 48  # model says all-device
+    ok, bits = hb.batch_verify(pubs, msgs, sigs)
+    assert ok and all(bits)
+    assert hb.last_share == 48
+    assert hb._bias == 2  # decayed, not frozen
+    # Second call: the first was the program's warm-up (first_use), the
+    # second records a real device wall for the bucket.
+    hb.batch_verify(pubs, msgs, sigs)
+    assert hb._bias == 1
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    assert ek.bucket_for(48) in hb._dev_wall
+
+
+@needs_native
+def test_small_batches_do_not_touch_controller(monkeypatch):
+    hb = _hybrid(monkeypatch, min_split=64)
+    hb._bias = 2
+    pubs, msgs, sigs = _batch(16)
+    ok, bits = hb.batch_verify(pubs, msgs, sigs)
+    assert ok and all(bits)
+    assert hb._bias == 2 and hb._dev_wall == {}
