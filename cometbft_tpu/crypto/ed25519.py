@@ -74,7 +74,7 @@ class PubKey(crypto.PubKey):
         # consensus loop batch-pre-verifies drained vote queues and fast
         # sync pre-verifies block windows, so the per-vote/per-commit
         # checks that follow land here already proven.
-        key = self._bytes + sig + bytes(msg)
+        key = (self._bytes, sig, bytes(msg))
         if key in _verified:
             return True
         handle = _cached_pubkey(self._bytes)
@@ -144,14 +144,16 @@ def _from_seed(seed: bytes) -> PrivKey:
 # VerifyCommit in ApplyBlock's validation — and the blocksync reactor
 # pre-verifies whole windows of blocks in one device dispatch). Only VALID
 # results are cached (deterministic; an attacker replaying a valid triple
-# gets the same answer crypto would give), keyed by the full concatenated
-# triple. Bounded: oldest quarter evicted on overflow.
+# gets the same answer crypto would give), keyed by the (pub, sig, msg)
+# TUPLE — bytes objects hash once and cache it, so tuple keys skip the
+# per-lookup concatenation a bytes key would pay (~8 MB of copies per
+# 10k-commit cached verify). Bounded: oldest quarter evicted on overflow.
 _VERIFIED_MAX = 131072
-_verified: dict[bytes, None] = {}
+_verified: dict[tuple, None] = {}
 _verified_lock = threading.Lock()
 
 
-def _verified_put_many(keys: list[bytes]) -> None:
+def _verified_put_many(keys: list[tuple]) -> None:
     """Insert verified triples under one lock acquisition (10k inserts after
     a commit verify would otherwise take the lock 10k times).  Writers race
     from multiple threads (blocksync pool routine, consensus, light client);
@@ -168,7 +170,7 @@ def _verified_put_many(keys: list[bytes]) -> None:
             _verified[key] = None
 
 
-def _verified_put(key: bytes) -> None:
+def _verified_put(key: tuple) -> None:
     _verified_put_many([key])
 
 
@@ -208,9 +210,7 @@ class BatchVerifier(crypto.BatchVerifier):
 
         if not self._pubs:
             return False, []
-        keys = [
-            p + s + m for p, s, m in zip(self._pubs, self._sigs, self._msgs)
-        ]
+        keys = list(zip(self._pubs, self._sigs, self._msgs))
         if all(k in _verified for k in keys):
             return True, [True] * len(keys)
         ok, bits = get_backend().batch_verify(self._pubs, self._msgs, self._sigs)

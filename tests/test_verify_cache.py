@@ -84,7 +84,7 @@ def test_single_verify_populates_and_consults_cache():
     priv = ed25519.gen_priv_key_from_secret(b"single")
     pub = priv.pub_key()
     msg, sig = b"one-shot", priv.sign(b"one-shot")
-    key = pub.bytes() + sig + msg
+    key = (pub.bytes(), sig, msg)
     assert key not in ed25519._verified
     assert pub.verify_signature(msg, sig)
     assert key in ed25519._verified, "valid single verify must cache"
@@ -94,7 +94,7 @@ def test_single_verify_populates_and_consults_cache():
     # invalid never lands in the cache
     bad = b"\x01" * 64
     assert not pub.verify_signature(msg, bad)
-    assert pub.bytes() + bad + msg not in ed25519._verified
+    assert (pub.bytes(), bad, msg) not in ed25519._verified
 
 
 def test_consensus_prebatch_warms_cache(counting_backend):
