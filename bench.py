@@ -491,9 +491,14 @@ def tpu_worker() -> None:
                 hok, hroot = run_hybrid()  # first call pays the share-bucket compile
                 assert hok, "hybrid batch must verify"
                 assert hroot == want_root, "hybrid root != host root"
-                # 6 reps: the rate EMA learns from reps 2+ and re-plans the
-                # split, so later reps run at the converged balance point.
-                stages["combined_hybrid_ms"] = round(best_of(run_hybrid, reps=6), 3)
+                # 10 reps (~1.5 s total): the rate EMA learns from reps 2+
+                # and re-plans the split each call, so later reps run at the
+                # converged balance point — and the tunnel's run-to-run
+                # variance (measured 50-150 ms fixed cost across watcher
+                # wakes) needs several samples for an honest best-of.
+                stages["combined_hybrid_ms"] = round(
+                    best_of(run_hybrid, reps=10), 3
+                )
                 stages["hybrid_device_share"] = hb.last_share
                 stages["hybrid_timing"] = dict(hb.last_timing)
                 stages["hybrid_rates"] = {
