@@ -1,0 +1,123 @@
+"""Multi-HOST distributed verification (SURVEY.md §5.8).
+
+The reference's distribution substrate is its p2p TCP mesh — every node
+re-verifies everything. This framework adds a second, orthogonal axis the
+reference cannot express: ONE logical verification step sharded across
+the chips of SEVERAL hosts, with XLA collectives riding ICI within a
+host and DCN between hosts. A JAX "process" per host joins a
+coordinator (`jax.distributed`), the global device list forms the same
+1-D `sig` mesh `ops/sharded.py` uses, and each host contributes only its
+process-local lane slice — packing is embarrassingly columnar (every
+packed lane depends on its own signature only, ed25519_kernel.pack_batch),
+so a host packs exactly the commit slice it was assigned. all_gather /
+psum give every host the identical Merkle root and all-valid bit.
+
+CPU hosts participate through the same code path via jaxlib's gloo
+collectives backend — which is also how this is TESTED without multi-host
+TPU hardware: tests/test_multihost.py spawns real OS processes, each with
+virtual CPU devices, forms the global mesh over the gloo coordinator, and
+cross-checks the root against the host tree (the same validation contract
+as __graft_entry__.dryrun_multichip, one level up the scaling ladder).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+
+# NOTE: ops.sharded (and through it the kernels + field25519's lowering
+# probe) is imported lazily inside the functions below — importing it at
+# module scope initializes the XLA backend, which must not happen before
+# distributed_init() joins the coordinator.
+
+
+def distributed_init(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_devices: int | None = None,
+) -> None:
+    """Join (or form) the multi-host verification cluster.
+
+    coordinator: "host:port" of process 0. For CPU hosts pass
+    local_devices (virtual devices per host) — it is applied to XLA_FLAGS
+    here, before backend init — and jaxlib's gloo backend carries the
+    collectives; on TPU hosts leave it None and the PJRT topology
+    provides the device set.
+    """
+    if local_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_devices}"
+            ).strip()
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # TPU-only jaxlib builds have no CPU collectives knob
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_local_columns(mesh, spec, global_shape, local_cols):
+    """Assemble a globally-sharded array from THIS host's column slice.
+
+    local_cols must be exactly the columns this process's devices own
+    under `spec` (mesh is 1-D over the batch axis, so that is the
+    contiguous [pid*shard : (pid+1)*shard] slice of the batch dim).
+    """
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.ascontiguousarray(local_cols), global_shape
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _step_for(mesh, axis):
+    """One jitted step per (mesh, axis) — a node runs this once per block,
+    and rebuilding the jit wrapper per call would pay a cache lookup and
+    wrapper allocation on the consensus hot path."""
+    from cometbft_tpu.ops import sharded
+
+    return sharded.sharded_commit_step_fn(mesh, axis)
+
+
+def multihost_commit_step(mesh, local_operands, local_leaf_digests, axis="sig"):
+    """Run ops/sharded.sharded_commit_step_fn with per-host inputs.
+
+    local_operands: this host's lane slice of the packed verify operands
+    (same tuple layout as ed25519_kernel.pack_batch, sliced on the batch
+    dim). local_leaf_digests: uint32[8, n_local] leaf-digest columns of
+    this host's Merkle shard. Returns (ok_local, all_valid, root_words):
+    ok_local is this host's slice of the validity bitmap; all_valid and
+    the root are replicated across every host by the step's collectives.
+    """
+    from cometbft_tpu.ops import sharded
+
+    n_proc = jax.process_count()
+    specs = (*sharded._verify_specs(axis), jax.sharding.PartitionSpec(None, axis))
+    arrays = []
+    for op, spec in zip((*local_operands, local_leaf_digests), specs):
+        gshape = list(op.shape)
+        # the sharded dim is the one carrying the batch axis in the spec
+        dim = list(spec).index(axis)
+        gshape[dim] = op.shape[dim] * n_proc
+        arrays.append(process_local_columns(mesh, spec, tuple(gshape), op))
+    *operands, leaves = arrays
+    step = _step_for(mesh, axis)
+    ok, all_valid, root = step(*operands, leaves)
+    # Per-host view of the sharded bitmap: the addressable shards.
+    local_ok = np.concatenate(
+        [np.asarray(s.data) for s in sorted(
+            ok.addressable_shards, key=lambda s: s.index[0].start or 0)]
+    )
+    return local_ok, bool(all_valid), np.asarray(root)

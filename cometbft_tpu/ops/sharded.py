@@ -116,6 +116,14 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
             NamedSharding(mesh, s)
             for s in (*_verify_specs(axis), P(None, axis))
         ),
+        # Explicit out shardings so every HOST of a multi-process mesh can
+        # read the verdict + root locally (ops/multihost.py): the bitmap
+        # stays batch-sharded, the all-valid bit and root are replicated.
+        out_shardings=(
+            NamedSharding(mesh, P(axis)),
+            NamedSharding(mesh, P()),
+            NamedSharding(mesh, P(None, None)),
+        ),
     )
 
 
@@ -137,7 +145,13 @@ def make_example_batch(n: int):
     return tuple(jnp.asarray(o) for o in operands)
 
 
+def example_txs(n: int) -> list[bytes]:
+    """The deterministic tx fixture shared by the multi-chip dryrun, the
+    multi-host worker, and their root cross-checks — one definition so the
+    copies cannot drift."""
+    return [b"tx-%d" % i for i in range(n)]
+
+
 def make_example_leaves(n: int):
     """Leaf digests uint32[8, n] for n power-of-two txs."""
-    txs = [b"tx-%d" % i for i in range(n)]
-    return jnp.asarray(mk.hash_leaves_device(txs))
+    return jnp.asarray(mk.hash_leaves_device(example_txs(n)))
