@@ -153,7 +153,7 @@ def warmup(buckets=(128, 1024, 6144, 10240), merkle_leaves=(1024, 65536)) -> Non
     msg = b"\x00" * 120  # canonical-vote-sized: 64 + 120 -> 2 blocks
     for b in buckets:
         operands, _ = pack_batch([b"\x00" * 32] * b, [msg] * b, [b"\x00" * 64] * b)
-        jax.block_until_ready(_compiled(*_bucket_key(operands))(*operands))
+        jax.block_until_ready(_verify_fn_for(operands)(*operands))
     from cometbft_tpu.ops import merkle_kernel as mk
 
     for n in merkle_leaves:
@@ -331,6 +331,46 @@ def _pool() -> _DeviceOwner:
     return _device_pool
 
 
+@functools.lru_cache(maxsize=1)
+def _sharded_verify():
+    """(local_device_count, sharded verify fn) when this PROCESS owns
+    multiple chips, else None. Routes the shipped BatchVerifier seam
+    across every process-local chip (ops/sharded's 1-D sig mesh —
+    lane-sharded operands, zero collectives in the verify body) instead
+    of leaving n-1 chips idle. Local, not global, devices: after
+    jax.distributed joins a multi-host cluster, a mesh over the global
+    device list would contain non-addressable devices and break every
+    ordinary local verify."""
+    n_dev = jax.local_device_count()
+    if n_dev <= 1 or HOST_HASH:
+        return None
+    from cometbft_tpu.ops import sharded
+
+    return n_dev, sharded.sharded_verify_fn(sharded.make_mesh(jax.local_devices()))
+
+
+def _verify_fn_for(operands):
+    """The compiled program the routing layer would run for these packed
+    operands: the lane-sharded multi-chip program when this process owns
+    several chips and the bucket divides evenly, else the single-device
+    bucket program. Shared by batch_verify_submit and warmup so warmup
+    precompiles what will actually run."""
+    key = _bucket_key(operands)
+    if key[1] != 0:  # hosthash program shapes aren't mesh-sharded
+        sh = _sharded_verify()
+        if sh is not None and operands[0].shape[1] % sh[0] == 0:
+            return sh[1]
+    return _compiled(*key)
+
+
+def clear_compiled_caches() -> None:
+    """Retrace seam for the fe-lowering tests: drops BOTH program caches
+    (the per-bucket single-device jits and the sharded-mesh jit) so a
+    flipped CMTPU_FE_MODE actually re-lowers what batch_verify runs."""
+    _compiled.cache_clear()
+    _sharded_verify.cache_clear()
+
+
 def batch_verify_submit(pubs, msgs, sigs):
     """Pack on the calling thread, dispatch on the device-owner thread,
     return a collect() -> (ok, bitmap) closure. The hybrid backend runs its
@@ -339,7 +379,7 @@ def batch_verify_submit(pubs, msgs, sigs):
     n = len(pubs)
     operands, host_ok = pack_batch(pubs, msgs, sigs)
     key = _bucket_key(operands)
-    fn = _compiled(*key)
+    fn = _verify_fn_for(operands)
     fut = _pool().submit(lambda: np.asarray(fn(*operands)))
 
     def collect() -> tuple[bool, list]:

@@ -164,3 +164,28 @@ def test_small_batches_do_not_touch_controller(monkeypatch):
     ok, bits = hb.batch_verify(pubs, msgs, sigs)
     assert ok and all(bits)
     assert hb._bias == 2 and hb._dev_wall == {}
+
+
+def test_multi_device_routing_shards_the_shipped_seam(monkeypatch):
+    """With >1 local device (the 8-device virtual mesh the conftest pins),
+    the device tier's batch_verify must route over the sharded sig mesh —
+    all chips working the batch — with the exact per-signature bitmap.
+    A spy proves the sharded program actually executed."""
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    sh = ek._sharded_verify()
+    assert sh is not None and sh[0] == 8
+    called = {}
+
+    def spy(*ops):
+        called["sharded"] = True
+        return sh[1](*ops)
+
+    monkeypatch.setattr(ek, "_sharded_verify", lambda: (sh[0], spy))
+    pubs, msgs, sigs = _batch(48, tag=b"mdev")
+    sigs[7] = b"\x00" * 64
+    msgs[40] = msgs[40] + b"x"
+    ok, bits = ek.batch_verify(pubs, msgs, sigs)
+    assert not ok
+    assert [i for i, b in enumerate(bits) if not b] == [7, 40]
+    assert called.get("sharded"), "batch_verify did not route via the mesh"

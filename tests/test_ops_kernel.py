@@ -195,7 +195,7 @@ def _force_mode_verify(mode: str, accel: bool):
     prev_mode, prev_accel = fe._MODE_ENV, fe._ACCEL
     fe._MODE_ENV, fe._ACCEL = mode, accel
     try:
-        ek._compiled.cache_clear()
+        ek.clear_compiled_caches()
         pubs, msgs, sigs = [], [], []
         for i in range(8):
             priv = ed25519.gen_priv_key_from_secret(b"%s-%d" % (mode.encode(), i))
@@ -208,7 +208,7 @@ def _force_mode_verify(mode: str, accel: bool):
         assert res == [True, True, True, False, True, True, True, True]
     finally:
         fe._MODE_ENV, fe._ACCEL = prev_mode, prev_accel
-        ek._compiled.cache_clear()
+        ek.clear_compiled_caches()
 
 
 def test_stacked_lowering_full_verify_on_cpu():
