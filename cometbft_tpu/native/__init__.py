@@ -188,13 +188,7 @@ def batch_verify(
     chal_buf = b"".join(
         sigs[i][:32] + pubs[i] + msgs[i] for i in cand
     )
-    import numpy as np
-
-    offs = (ctypes.c_uint64 * (m + 1))()
-    np.cumsum(
-        np.fromiter((64 + len(msgs[i]) for i in cand), np.uint64, m),
-        out=np.frombuffer(offs, np.uint64)[1:],
-    )
+    offs = _offsets((64 + len(msgs[i]) for i in cand), m)
     digests = ctypes.create_string_buffer(64 * m)
     lib.cmtpu_sha512_batch(m, chal_buf, offs, digests)
 
@@ -257,19 +251,21 @@ def batch_verify(
     return all(bits), bits
 
 
-def _leaf_offsets(leaves: list[bytes]):
-    """uint64[n+1] cumulative offsets as a ctypes array — vectorized; the
-    obvious python accumulation loop costs ~10 ms at 64k leaves on a small
-    host, which was a visible slice of the hybrid tier's merkle overlap."""
+def _offsets(lengths, n: int):
+    """uint64[n+1] cumulative offsets as a ctypes array from an iterable of
+    n lengths — vectorized; the obvious python accumulation loop costs
+    ~10 ms at 64k entries on a small host, which was a visible slice of
+    the hybrid tier's merkle overlap."""
     import numpy as np
 
-    n = len(leaves)
     offs = (ctypes.c_uint64 * (n + 1))()
     view = np.frombuffer(offs, np.uint64)
-    np.cumsum(
-        np.fromiter((len(v) for v in leaves), np.uint64, n), out=view[1:]
-    )
+    np.cumsum(np.fromiter(lengths, np.uint64, n), out=view[1:])
     return offs
+
+
+def _leaf_offsets(leaves: list[bytes]):
+    return _offsets((len(v) for v in leaves), len(leaves))
 
 
 def merkle_root(leaves: list[bytes]) -> bytes:

@@ -29,10 +29,12 @@ def redial_delay(attempt: int) -> float:
     if attempt <= REDIAL_LINEAR_ATTEMPTS:
         base = REDIAL_LINEAR_SLEEP_S
     else:
-        base = min(
-            REDIAL_LINEAR_SLEEP_S * 2.0 ** (attempt - REDIAL_LINEAR_ATTEMPTS),
-            REDIAL_MAX_SLEEP_S,
-        )
+        # Clamp the exponent BEFORE computing the power: a peer down for
+        # a day pushes attempt past 1000 and 2.0**1000 overflows float,
+        # which would kill the redial thread right when persistence
+        # matters most.
+        exp = min(attempt - REDIAL_LINEAR_ATTEMPTS, 16)
+        base = min(REDIAL_LINEAR_SLEEP_S * 2.0 ** exp, REDIAL_MAX_SLEEP_S)
     return base * (0.8 + 0.4 * random.random())
 
 
@@ -89,6 +91,9 @@ class Switch:
         self._running = False
         self._persistent_addrs: list[str] = []
         self._dialing: set[str] = set()
+        # Peer instances whose connection died before they reached the
+        # table (stop_peer_for_error in _add_peer's start->insert window).
+        self._dead: set[Peer] = set()
 
     # -- reactors -------------------------------------------------------------
 
@@ -166,8 +171,15 @@ class Switch:
             # + outbound dial, same id) passes the pre-upgrade duplicate
             # check in both threads; overwriting here would displace a peer
             # that reactors were told about and that stop_peer_for_error's
-            # instance check would then never clean up.
-            if up.peer_id in self._peers:
+            # instance check would then never clean up. The _dead check
+            # covers the other window: the conn can die between start()
+            # and this insert, in which case stop_peer_for_error found no
+            # table entry and tombstoned the instance — tabling it anyway
+            # would park a permanently-idle ghost that blocks redial.
+            if peer in self._dead:
+                self._dead.discard(peer)
+                dup = True
+            elif up.peer_id in self._peers:
                 dup = True
             else:
                 self._peers[peer.id] = peer
@@ -177,6 +189,15 @@ class Switch:
             return
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
+        with self._mtx:
+            still_tabled = self._peers.get(peer.id) is peer
+        if not still_tabled:
+            # Removal raced the add_peer loop above: the remover's
+            # reactor.remove_peer ran before (some) add_peer calls, which
+            # would leave gossip state for a stopped peer. remove_peer is
+            # idempotent in every reactor, so re-run it.
+            for reactor in self.reactors.values():
+                reactor.remove_peer(peer, "removal raced add")
 
     def dial_peer(self, addr: str) -> Peer | None:
         """addr format: id@host:port."""
@@ -239,6 +260,15 @@ class Switch:
             existing = self._peers.get(peer.id)
             if existing is peer:
                 del self._peers[peer.id]
+            else:
+                # Not (or not yet) tabled: possibly an error that fired in
+                # _add_peer's start()->insert window. Tombstone the
+                # instance so _add_peer won't table a dead peer; bounded
+                # because _add_peer discards matches and the set only
+                # grows on repeated errors from never-tabled instances.
+                self._dead.add(peer)
+                while len(self._dead) > 256:
+                    self._dead.pop()
         # Always stop THIS instance's threads, but only the instance that
         # owns the table entry may tear down reactor state: a dead
         # connection errors from both its send and recv routines, and with

@@ -204,17 +204,31 @@ class HybridBackend(VerifyBackend):
         n = len(pubs)
         if n == 0:
             return False, []
+        if n < self._min_split:
+            # Small batches route host-side REGARDLESS of the native
+            # build's state: below the split threshold even per-signature
+            # OpenSSL (CpuBackend's own fallback) beats the tunnel's fixed
+            # dispatch cost, and tiny batches carry no useful rate signal
+            # and must not decay the bias learned on commit-sized ones.
+            return self._cpu.batch_verify(pubs, msgs, sigs)
         if self._native.ready() is None:
             # Native tier still building (first seconds of a fresh host):
-            # the device alone beats the sequential-OpenSSL fallback.
+            # for commit-sized batches the device beats sequential OpenSSL.
             return self._tpu.batch_verify(pubs, msgs, sigs)
-        if n < self._min_split:
-            # Tiny batches carry no useful rate signal and must not decay
-            # the bias learned on commit-sized ones.
-            return self._cpu.batch_verify(pubs, msgs, sigs)
         share = self._plan(n)
+        res, _ = self._routed_call(pubs, msgs, sigs, share)
+        return res
+
+    def _routed_call(self, pubs, msgs, sigs, share, between=None):
+        """Execute one planned verification: all-host (share<=0), all-device
+        (share>=n), or the concurrent split — the ONE copy of the
+        plan->submit->host MSM->overlap->collect->rate-update protocol.
+        `between` (optional) runs under the device wait (verify_and_root's
+        merkle); returns ((ok, bitmap), between_result)."""
         from cometbft_tpu.ops import ed25519_kernel as ek
 
+        n = len(pubs)
+        extra = None
         if share <= 0:
             self.last_share = 0
             t0 = time.perf_counter()
@@ -225,33 +239,32 @@ class HybridBackend(VerifyBackend):
                     r = min(max(n / host_ms, 5.0), 5000.0)
                     self._host_rate += 0.3 * (r - self._host_rate)
                 self._decay_bias()
-            return res
-        if share >= n:
-            self.last_share = n
-            t0 = time.perf_counter()
-            collect = ek.batch_verify_submit(pubs, msgs, sigs)
-            t_disp = time.perf_counter()
-            res = collect()
-            t_dev = time.perf_counter()
-            self._update_rates(
-                collect.program_key, n, 0, t0, t_disp, t_disp, t_disp, t_dev
-            )
-            return res
-
+            if between is not None:
+                extra = between()
+            return res, extra
+        share = min(share, n)
         self.last_share = share
         t0 = time.perf_counter()
         collect = ek.batch_verify_submit(pubs[:share], msgs[:share], sigs[:share])
         t_disp = time.perf_counter()
-        ok_h, bits_h = self._native.batch_verify(
-            pubs[share:], msgs[share:], sigs[share:]
-        )
+        if share < n:
+            ok_h, bits_h = self._native.batch_verify(
+                pubs[share:], msgs[share:], sigs[share:]
+            )
+        else:
+            ok_h, bits_h = True, []
         t_host = time.perf_counter()
+        if between is not None:
+            extra = between()
+        t_wait = time.perf_counter()
         ok_d, bits_d = collect()
         t_dev = time.perf_counter()
         self._update_rates(
-            collect.program_key, share, n - share, t0, t_disp, t_host, t_host, t_dev
+            collect.program_key, share, n - share, t0, t_disp, t_host, t_wait, t_dev
         )
-        return ok_d and ok_h, bits_d + bits_h
+        if share < n:
+            return (ok_d and ok_h, bits_d + bits_h), extra
+        return (ok_d, bits_d), extra
 
     def _update_rates(self, key, n_dev, n_host, t0, t_disp, t_host, t_wait, t_dev):
         """EMA the rate model from what this call actually measured. The
@@ -320,51 +333,18 @@ class HybridBackend(VerifyBackend):
 
     def verify_and_root(self, pubs, msgs, sigs, leaves):
         """The commit-verification + block-tree fusion: device share in
-        flight while the host runs its MSM share AND the SHA-NI merkle tree.
-        Returns ((ok, bitmap), root)."""
+        flight while the host runs its MSM share AND the SHA-NI merkle tree
+        (_routed_call's `between` hook). Returns ((ok, bitmap), root)."""
         n = len(pubs)
-        share = 0
-        if n >= self._min_split and self._native.ready() is not None:
-            share = min(self._plan(n), n)
-        from cometbft_tpu.ops import ed25519_kernel as ek
-
-        if 0 < share < n:
-            self.last_share = share
-            t0 = time.perf_counter()
-            collect = ek.batch_verify_submit(
-                pubs[:share], msgs[:share], sigs[:share]
-            )
-            t_disp = time.perf_counter()
-            ok_h, bits_h = self._native.batch_verify(
-                pubs[share:], msgs[share:], sigs[share:]
-            )
-            t_host = time.perf_counter()
-            root = self.merkle_root(leaves)
-            t_wait = time.perf_counter()
-            ok_d, bits_d = collect()
-            t_dev = time.perf_counter()
-            self._update_rates(
-                collect.program_key, share, n - share, t0, t_disp, t_host,
-                t_wait, t_dev,
-            )
-            return (ok_d and ok_h, bits_d + bits_h), root
-        if share >= n > 0:
-            # All-device plan: still overlap the host merkle with the
-            # device wait instead of serializing it after a blocking verify.
-            self.last_share = n
-            t0 = time.perf_counter()
-            collect = ek.batch_verify_submit(pubs, msgs, sigs)
-            t_disp = time.perf_counter()
-            root = self.merkle_root(leaves)
-            t_wait = time.perf_counter()
-            res = collect()
-            t_dev = time.perf_counter()
-            self._update_rates(
-                collect.program_key, n, 0, t0, t_disp, t_disp, t_wait, t_dev
-            )
-            return res, root
-        ok, bits = self.batch_verify(pubs, msgs, sigs)
-        return (ok, bits), self.merkle_root(leaves)
+        if n == 0:
+            return (False, []), self.merkle_root(leaves)
+        if n < self._min_split or self._native.ready() is None:
+            ok, bits = self.batch_verify(pubs, msgs, sigs)
+            return (ok, bits), self.merkle_root(leaves)
+        share = self._plan(n)
+        return self._routed_call(
+            pubs, msgs, sigs, share, between=lambda: self.merkle_root(leaves)
+        )
 
 
 _backend: VerifyBackend | None = None
