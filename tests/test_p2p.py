@@ -310,7 +310,9 @@ def test_redial_delay_two_phase():
         assert 0.8 <= redial_delay(attempt) <= 1.2
     assert 1.6 <= redial_delay(21) <= 2.4
     assert 3.2 <= redial_delay(22) <= 4.8
-    for attempt in (26, 30, 100):
+    for attempt in (26, 30, 100, 5000):
+        # 5000: a peer down for days must neither overflow float in the
+        # exponent nor kill the redial thread
         assert redial_delay(attempt) <= 60.0 * 1.2
     assert redial_delay(40) >= 60.0 * 0.8
 
