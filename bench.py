@@ -196,11 +196,18 @@ def _devnet_throughput(seconds: float = 12.0, n_vals: int = 4):
 
 def _pick_headline(stages: dict) -> float:
     """Headline = fastest measured combined path; records which one won so
-    the JSON schema is identical for full and truncated emits."""
-    headline = stages["combined_ms"]
+    the JSON schema is identical for full and truncated emits.  A truncated
+    snapshot may predate the combined stage entirely — emit a -1 sentinel
+    then, so the watchdog's partial record still goes out instead of a
+    KeyError being swallowed by its bare except."""
+    headline = stages.get("combined_ms")
     stages["combined_path"] = "device"
     hyb = stages.get("combined_hybrid_ms")
-    if hyb is not None and hyb < headline:
+    if headline is None:
+        headline, stages["combined_path"] = (
+            (hyb, "hybrid") if hyb is not None else (-1.0, "none")
+        )
+    elif hyb is not None and hyb < headline:
         headline, stages["combined_path"] = hyb, "hybrid"
     return headline
 
@@ -461,7 +468,11 @@ def tpu_worker() -> None:
                 # Snapshot: the main thread may be mutating stages mid-stall.
                 snap = dict(stages)
             except RuntimeError:
-                snap = {"combined_ms": stages["combined_ms"]}
+                snap = (
+                    {"combined_ms": stages["combined_ms"]}
+                    if stages.get("combined_ms") is not None
+                    else {}
+                )
             snap["truncated"] = True
             plog("stage budget exhausted mid-stage; emitting partial result")
             try:

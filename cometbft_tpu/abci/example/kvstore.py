@@ -293,8 +293,8 @@ class PersistentKVStoreApplication(KVStoreApplication):
     """abci/example/kvstore/persistent_kvstore.go: adds validator-set changes
     driven by "val:base64(pubkey)!power" transactions."""
 
-    def __init__(self, db: DB | None = None):
-        super().__init__(db)
+    def __init__(self, db: DB | None = None, **kwargs):
+        super().__init__(db, **kwargs)
         self._val_updates: list[abci.ValidatorUpdate] = []
         self._validators: dict[bytes, int] = {}  # pubkey bytes -> power
         raw = self.db.get(b"validatorsKey")

@@ -162,7 +162,7 @@ def main(argv=None) -> int:
     import time
 
     p = argparse.ArgumentParser(prog="cometbft_tpu.abci.server")
-    p.add_argument("app", choices=["kvstore", "noop"])
+    p.add_argument("app", choices=["kvstore", "persistent_kvstore", "noop"])
     p.add_argument("--addr", default="tcp://127.0.0.1:26658")
     p.add_argument(
         "--transport",
@@ -176,6 +176,12 @@ def main(argv=None) -> int:
         from cometbft_tpu.abci.example.kvstore import KVStoreApplication
 
         app = KVStoreApplication(snapshot_interval=args.snapshot_interval)
+    elif args.app == "persistent_kvstore":
+        from cometbft_tpu.abci.example.kvstore import PersistentKVStoreApplication
+
+        app = PersistentKVStoreApplication(
+            snapshot_interval=args.snapshot_interval
+        )
     else:
         app = abci.Application()
     if args.transport == "grpc":

@@ -465,22 +465,34 @@ def cmd_debug(args) -> int:
 
 
 def cmd_testnet(args) -> int:
-    """cmd/cometbft/commands/testnet.go: generate N validator homes with a
-    shared genesis."""
+    """cmd/cometbft/commands/testnet.go: generate validator (+ optional
+    non-validator) homes with a shared genesis.  --key-types is a comma
+    list cycled across nodes (testnet.go's --key-type, generalized so the
+    e2e generator can mix consensus key types in one net)."""
     from cometbft_tpu.config import default_config
     from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.privval.file import KEY_TYPES
     from cometbft_tpu.types import cmttime
     from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 
     n = args.validators
+    total = n + getattr(args, "non_validators", 0)
+    key_types = [k.strip() for k in args.key_types.split(",") if k.strip()]
+    for k in key_types:
+        if k not in KEY_TYPES:
+            print(f"unknown key type {k!r} (want one of {KEY_TYPES})",
+                  file=sys.stderr)
+            return 1
     pvs = []
-    for i in range(n):
+    for i in range(total):
         home = os.path.join(args.output_dir, f"node{i}")
         cfg = default_config().set_root(home)
         os.makedirs(os.path.join(home, "config"), exist_ok=True)
         os.makedirs(os.path.join(home, "data"), exist_ok=True)
         pv = FilePV.load_or_generate(
-            cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+            cfg.base.priv_validator_key_path(),
+            cfg.base.priv_validator_state_path(),
+            key_type=key_types[i % len(key_types)],
         )
         _write_node_key(cfg.base.node_key_path())
         pvs.append(pv)
@@ -489,13 +501,13 @@ def cmd_testnet(args) -> int:
         genesis_time=cmttime.now(),
         validators=[
             GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 1, f"node{i}")
-            for i, pv in enumerate(pvs)
+            for i, pv in enumerate(pvs[:n])
         ],
     )
     doc.validate_and_complete()
-    for i in range(n):
+    for i in range(total):
         doc.save_as(os.path.join(args.output_dir, f"node{i}", "config", "genesis.json"))
-    print(f"Successfully initialized {n} node directories in {args.output_dir}")
+    print(f"Successfully initialized {total} node directories in {args.output_dir}")
     return 0
 
 
@@ -516,12 +528,59 @@ def cmd_loadtime(args) -> int:
     return 0
 
 
+def _parse_seeds(spec: str) -> list[int]:
+    """'3', '1,4,9' or inclusive '0..7' (the generator matrix convention)."""
+    seeds: list[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ".." in part:
+            lo, hi = part.split("..", 1)
+            seeds.extend(range(int(lo), int(hi) + 1))
+        else:
+            seeds.append(int(part))
+    if not seeds:
+        raise ValueError(f"no seeds in {spec!r}")
+    return seeds
+
+
 def cmd_e2e(args) -> int:
-    """Manifest-driven e2e testnet run (reference: test/e2e/runner)."""
+    """Manifest-driven e2e testnet runs (reference: test/e2e/runner +
+    test/e2e/generator): run one manifest, generate a seeded random one,
+    or sweep a seed range through the runner."""
     import tempfile
 
+    sub = getattr(args, "e2e_cmd", None)
+    if sub == "generate":
+        from cometbft_tpu.e2e_generator import generate
+
+        text = generate(args.seed, profile=args.profile)
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(f"wrote manifest for seed {args.seed} to {args.out}")
+        else:
+            print(text, end="")
+        return 0
+    if sub == "matrix":
+        from cometbft_tpu.e2e_generator import run_matrix
+
+        out = args.output_dir or tempfile.mkdtemp(prefix="cmtpu-e2e-matrix-")
+        summary = run_matrix(
+            _parse_seeds(args.seeds), out, profile=args.profile,
+            log=lambda s: print(s, file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        return 0 if not summary["failed"] else 1
+
+    # `e2e run --manifest m.toml` (and the original flat `e2e --manifest`).
     from cometbft_tpu.e2e_runner import E2ERunner
 
+    if not args.manifest:
+        print("e2e: --manifest is required", file=sys.stderr)
+        return 1
     out = args.output_dir or tempfile.mkdtemp(prefix="cmtpu-e2e-")
     runner = E2ERunner(
         args.manifest, out, log=lambda s: print(s, file=sys.stderr)
@@ -578,6 +637,10 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="tcp://127.0.0.1:26657")
     sp = sub.add_parser("testnet")
     sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--non-validators", type=int, default=0,
+                    help="extra full-node homes not in the genesis valset")
+    sp.add_argument("--key-types", default="ed25519",
+                    help="comma list of consensus key types, cycled per node")
     sp.add_argument("--output-dir", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
     sp = sub.add_parser("loadtime")
@@ -586,8 +649,27 @@ def main(argv=None) -> int:
     sp.add_argument("--blocks", type=int, default=100)
     sp.add_argument("--validators", type=int, default=4)
     sp = sub.add_parser("e2e")
-    sp.add_argument("--manifest", required=True, help="TOML testnet manifest")
+    # Flat flags keep `e2e --manifest m.toml` working; the nested
+    # subcommands mirror the reference's runner/generator split.
+    sp.add_argument("--manifest", default="", help="TOML testnet manifest")
     sp.add_argument("--output-dir", default="")
+    e2e_sub = sp.add_subparsers(dest="e2e_cmd")
+    ep = e2e_sub.add_parser("run", help="run one manifest through the runner")
+    ep.add_argument("--manifest", required=True, help="TOML testnet manifest")
+    ep.add_argument("--output-dir", default="")
+    ep = e2e_sub.add_parser(
+        "generate", help="emit a seeded randomized testnet manifest"
+    )
+    ep.add_argument("--seed", type=int, required=True)
+    ep.add_argument("--profile", default="full", choices=["full", "small"])
+    ep.add_argument("--out", default="", help="output path (default stdout)")
+    ep = e2e_sub.add_parser(
+        "matrix", help="generate + run a seed range, collect repro artifacts"
+    )
+    ep.add_argument("--seeds", required=True,
+                    help="seed spec: N, 'A..B' (inclusive) or comma list")
+    ep.add_argument("--profile", default="small", choices=["full", "small"])
+    ep.add_argument("--output-dir", default="")
 
     args = p.parse_args(argv)
     handlers = {

@@ -28,6 +28,10 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     abci: str = "socket"
     filter_peers: bool = False
+    # In-process kvstore apps only (reference keeps this app-side, in the
+    # e2e app's own config — test/e2e/app/app.go): take a state snapshot
+    # every N heights so peers can statesync from this node.  0 = off.
+    snapshot_interval: int = 0
 
     def genesis_path(self) -> str:
         return os.path.join(self.root_dir, self.genesis_file)

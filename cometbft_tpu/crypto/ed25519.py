@@ -18,14 +18,13 @@ from __future__ import annotations
 import hashlib
 import threading
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-
 from cometbft_tpu import crypto
 from cometbft_tpu.crypto import ed25519_pure, tmhash
+from cometbft_tpu.crypto.compat import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+    InvalidSignature,
+)
 
 KEY_TYPE = "ed25519"
 PUB_KEY_SIZE = 32
@@ -74,7 +73,7 @@ class PubKey(crypto.PubKey):
         # consensus loop batch-pre-verifies drained vote queues and fast
         # sync pre-verifies block windows, so the per-vote/per-commit
         # checks that follow land here already proven.
-        key = (self._bytes, sig, bytes(msg))
+        key = (self._bytes, bytes(sig), bytes(msg))
         if key in _verified:
             return True
         handle = _cached_pubkey(self._bytes)

@@ -1,14 +1,15 @@
 """XChaCha20-Poly1305 AEAD (reference: crypto/xchacha20poly1305/).
 
-HChaCha20 subkey derivation + standard ChaCha20-Poly1305 (via `cryptography`),
-24-byte nonces. Used for key armoring and symmetric encryption.
+HChaCha20 subkey derivation + standard ChaCha20-Poly1305 (via
+crypto/compat — the `cryptography` wheel when present, pure RFC 8439
+otherwise), 24-byte nonces. Used for key armoring and symmetric encryption.
 """
 
 from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cometbft_tpu.crypto.compat import ChaCha20Poly1305
 
 KEY_SIZE = 32
 NONCE_SIZE = 24

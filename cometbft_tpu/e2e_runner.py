@@ -14,11 +14,26 @@ Manifest subset (same field names as the reference where they apply):
     initial_height = 1
     load_tx_rate = 100          # tx/s sustained against node 0
     target_blocks = 12          # blocks every node must reach post-perturb
+    abci_protocol = "builtin"   # informational default; per-node overrides
+    backend = "cpu"             # CMTPU_BACKEND for every node (cpu | hybrid)
+    app = "kvstore"             # kvstore | persistent_kvstore
+    snapshot_interval = 3       # app-side snapshots on genesis nodes
+    validator_churn = true      # add+remove a validator via val: txs mid-run
+    light_client = true         # sequentially verify the agreed height
     [node.validator01]
     [node.validator02]
     perturb = ["pause", "kill"]
     [node.validator03]
-    perturb = ["disconnect"]
+    key_type = "secp256k1"      # consensus key: ed25519 default
+    abci = "socket"             # local | socket | grpc app boundary
+    [node.full01]
+    mode = "full"
+    start_at = 5                # late join once the net reaches this height
+    state_sync = true           # join via verified snapshot restore
+
+Ordering contract (the generator enforces, load() validates): genesis
+validators come first — node 0 is the height reference, load target and
+statesync trust source, so it must be a genesis validator.
 
 Run: ``python -m cometbft_tpu.cmd e2e --manifest m.toml`` or
 ``E2ERunner(manifest_path).run()``.
@@ -26,6 +41,7 @@ Run: ``python -m cometbft_tpu.cmd e2e --manifest m.toml`` or
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import re
@@ -35,14 +51,29 @@ import subprocess
 import sys
 import threading
 import time
-import tomllib
 from dataclasses import dataclass, field
+
+from cometbft_tpu.libs import tomlcompat as tomllib
+
+MODES = ("validator", "full", "seed")
+ABCI_MODES = ("local", "socket", "grpc")
+PERTURBATIONS = ("kill", "pause", "disconnect", "restart")
+BACKENDS = ("cpu", "hybrid")
+APPS = ("kvstore", "persistent_kvstore")
 
 
 @dataclass
 class ManifestNode:
     name: str
+    mode: str = "validator"  # validator | full | seed
+    key_type: str = "ed25519"  # consensus key type (validators)
+    start_at: int = 0  # 0 = genesis; >0 = join at that net height
+    state_sync: bool = False  # late join via snapshot restore
+    abci: str = "local"  # local | socket | grpc app boundary
     perturb: list[str] = field(default_factory=list)
+
+    def is_validator(self) -> bool:
+        return self.mode == "validator"
 
 
 @dataclass
@@ -50,29 +81,87 @@ class Manifest:
     initial_height: int = 1
     load_tx_rate: int = 50
     target_blocks: int = 8
+    backend: str = "cpu"  # CMTPU_BACKEND handed to every node
+    app: str = "kvstore"  # ABCI app all nodes run
+    snapshot_interval: int = 0  # app snapshots on genesis nodes
+    validator_churn: bool = False  # val: tx add/remove mid-run
+    light_client: bool = False  # verify the agreed height
+    seed: int = -1  # generator seed (informational; -1 = hand-written)
     nodes: list[ManifestNode] = field(default_factory=list)
 
     @classmethod
     def load(cls, path: str) -> "Manifest":
         with open(path, "rb") as f:
             raw = tomllib.load(f)
+        from cometbft_tpu.privval.file import KEY_TYPES
+
         nodes = [
-            ManifestNode(name=name, perturb=list(spec.get("perturb", [])))
+            ManifestNode(
+                name=name,
+                mode=str(spec.get("mode", "validator")),
+                key_type=str(spec.get("key_type", "ed25519")),
+                start_at=int(spec.get("start_at", 0)),
+                state_sync=bool(spec.get("state_sync", False)),
+                abci=str(spec.get("abci", "local")),
+                perturb=list(spec.get("perturb", [])),
+            )
             for name, spec in raw.get("node", {}).items()
         ]
         if not nodes:
             raise ValueError("manifest has no [node.*] entries")
-        known = {"kill", "pause", "disconnect", "restart"}
-        for n in nodes:
-            bad = set(n.perturb) - known
-            if bad:
-                raise ValueError(f"{n.name}: unknown perturbations {sorted(bad)}")
-        return cls(
+        m = cls(
             initial_height=int(raw.get("initial_height", 1)),
             load_tx_rate=int(raw.get("load_tx_rate", 50)),
             target_blocks=int(raw.get("target_blocks", 8)),
+            backend=str(raw.get("backend", "cpu")),
+            app=str(raw.get("app", "kvstore")),
+            snapshot_interval=int(raw.get("snapshot_interval", 0)),
+            validator_churn=bool(raw.get("validator_churn", False)),
+            light_client=bool(raw.get("light_client", False)),
+            seed=int(raw.get("seed", -1)),
             nodes=nodes,
         )
+        for n in nodes:
+            bad = set(n.perturb) - set(PERTURBATIONS)
+            if bad:
+                raise ValueError(f"{n.name}: unknown perturbations {sorted(bad)}")
+            if n.mode not in MODES:
+                raise ValueError(f"{n.name}: unknown mode {n.mode!r}")
+            if n.key_type not in KEY_TYPES:
+                raise ValueError(f"{n.name}: unknown key_type {n.key_type!r}")
+            if n.abci not in ABCI_MODES:
+                raise ValueError(f"{n.name}: unknown abci mode {n.abci!r}")
+            if n.state_sync and n.start_at <= 0:
+                raise ValueError(f"{n.name}: state_sync requires start_at > 0")
+        if m.backend not in BACKENDS:
+            raise ValueError(f"unknown backend {m.backend!r}")
+        if m.app not in APPS:
+            raise ValueError(f"unknown app {m.app!r}")
+        if m.validator_churn and m.app != "persistent_kvstore":
+            raise ValueError("validator_churn requires app = 'persistent_kvstore'")
+        if any(n.state_sync for n in nodes) and m.snapshot_interval <= 0:
+            raise ValueError("state_sync nodes need snapshot_interval > 0")
+        first = nodes[0]
+        if not (first.is_validator() and first.start_at == 0):
+            raise ValueError(
+                "node 0 must be a genesis validator (height reference + "
+                "load target + statesync trust source)"
+            )
+        if not any(n.is_validator() and n.start_at == 0 for n in nodes):
+            raise ValueError("manifest needs at least one genesis validator")
+        # Equal-power quorum: the genesis validators that start at t0 must
+        # alone hold > 2/3 of the validator power, or the chain never moves.
+        v_total = sum(1 for n in nodes if n.is_validator())
+        v_late = sum(1 for n in nodes if n.is_validator() and n.start_at > 0)
+        if v_late and 3 * (v_total - v_late) <= 2 * v_total:
+            raise ValueError(
+                f"{v_late} late-join validators of {v_total} break quorum "
+                "at genesis"
+            )
+        return m
+
+    def validators(self) -> list[ManifestNode]:
+        return [n for n in self.nodes if n.is_validator()]
 
 
 def _free_ports(n: int) -> list[int]:
@@ -93,61 +182,187 @@ class E2ERunner:
         self.home = home
         self.log = log
         self.procs: dict[str, subprocess.Popen] = {}
+        self.app_procs: dict[str, subprocess.Popen] = {}
         self.rpc_ports: dict[str, int] = {}
         self.p2p_ports: dict[str, int] = {}
+        self._log_files: list = []
 
     # -- setup ------------------------------------------------------------
 
     def setup(self) -> None:
-        """testnet homes + config.toml per node (runner/setup.go shape)."""
+        """testnet homes + config.toml per node (runner/setup.go shape).
+
+        The testnet CLI lays down homes validators-first (matching the
+        manifest's ordering contract); per-node config then specializes
+        the proxy_app boundary, statesync arming, and snapshot cadence."""
         from cometbft_tpu.cmd.__main__ import main as cli
         from cometbft_tpu.config import default_config
         from cometbft_tpu.config.toml import write_config_file
         from cometbft_tpu.p2p.key import NodeKey
 
-        names = [n.name for n in self.manifest.nodes]
+        nodes = self.manifest.nodes
+        n_validators = len(self.manifest.validators())
+        key_types = ",".join(n.key_type for n in nodes)
         assert cli(
-            ["testnet", "--validators", str(len(names)),
+            ["testnet", "--validators", str(n_validators),
+             "--non-validators", str(len(nodes) - n_validators),
+             "--key-types", key_types,
              "--output-dir", self.home, "--chain-id", "e2e-manifest"]
         ) == 0
-        p2p = _free_ports(len(names))
-        rpc = _free_ports(len(names))
+        p2p = _free_ports(len(nodes))
+        rpc = _free_ports(len(nodes))
         node_ids = [
             NodeKey.load(
                 os.path.join(self.home, f"node{i}", "config", "node_key.json")
             ).id
-            for i in range(len(names))
+            for i in range(len(nodes))
         ]
         peers = [
-            f"{node_ids[i]}@127.0.0.1:{p2p[i]}" for i in range(len(names))
+            f"{node_ids[i]}@127.0.0.1:{p2p[i]}" for i in range(len(nodes))
         ]
-        for i, name in enumerate(names):
+        # Every node dials the genesis cohort; late joiners are dial-only
+        # (nobody lists a peer that isn't up yet — the switch would retry
+        # forever, which is allowed but noisy).
+        genesis_idx = [i for i, n in enumerate(nodes) if n.start_at == 0]
+        for i, node in enumerate(nodes):
             home = os.path.join(self.home, f"node{i}")
             cfg = default_config()
             cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc[i]}"
             cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p[i]}"
             cfg.p2p.persistent_peers = ",".join(
-                p for j, p in enumerate(peers) if j != i
+                peers[j] for j in genesis_idx if j != i
             )
             cfg.p2p.addr_book_strict = False
+            cfg.p2p.allow_duplicate_ip = True
+            cfg.p2p.seed_mode = node.mode == "seed"
             cfg.consensus.timeout_commit = 0.2
             cfg.consensus.skip_timeout_commit = False
+            cfg.base.proxy_app = self._proxy_app_addr(i, node)
+            if node.start_at == 0:
+                # Only genesis nodes serve snapshots — a restoring node
+                # re-offering its own half-built snapshot is the reference's
+                # self-serve footgun.
+                cfg.base.snapshot_interval = self.manifest.snapshot_interval
+            if node.state_sync:
+                cfg.statesync.enable = True
+                # Trust basis (height + hash) is only knowable at launch
+                # time; _launch_late rewrites this file then.
+                rpc_servers = [
+                    f"http://127.0.0.1:{rpc[j]}" for j in genesis_idx[:2]
+                ]
+                if len(rpc_servers) == 1:
+                    rpc_servers *= 2  # primary + witness may be the same
+                cfg.statesync.rpc_servers = tuple(rpc_servers)
+                cfg.statesync.trust_height = 1
+                cfg.statesync.discovery_time = 2.0
             write_config_file(os.path.join(home, "config", "config.toml"), cfg)
-            self.rpc_ports[name] = rpc[i]
-            self.p2p_ports[name] = p2p[i]
+            self.rpc_ports[node.name] = rpc[i]
+            self.p2p_ports[node.name] = p2p[i]
+
+    def _proxy_app_addr(self, idx: int, node: ManifestNode) -> str:
+        """local -> in-process app name; socket/grpc -> a unix socket under
+        the node home served by an external app process."""
+        if node.abci == "local":
+            return self.manifest.app
+        sock = os.path.join(self.home, f"node{idx}", "app.sock")
+        return f"grpc://{sock}" if node.abci == "grpc" else f"unix://{sock}"
+
+    # -- process management ----------------------------------------------
+
+    def _node_env(self) -> dict:
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "CMTPU_BACKEND": self.manifest.backend,
+        }
+        if self.manifest.backend == "cpu":
+            # A cpu-pinned net must never dial the axon relay from every
+            # node process (sitecustomize does, whenever this is set).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        return env
+
+    def _open_log(self, idx: int, suffix: str = "node"):
+        path = os.path.join(self.home, f"node{idx}", f"{suffix}.log")
+        f = open(path, "ab")
+        self._log_files.append(f)
+        return f
+
+    def _launch_app(self, idx: int, node: ManifestNode) -> None:
+        """External ABCI app process for socket/grpc nodes (the reference
+        runs the e2e app in its own container entrypoint)."""
+        if node.abci == "local":
+            return
+        sock = os.path.join(self.home, f"node{idx}", "app.sock")
+        if os.path.exists(sock):
+            os.unlink(sock)
+        addr = f"grpc://{sock}" if node.abci == "grpc" else f"unix://{sock}"
+        logf = self._open_log(idx, suffix="app")
+        snapshot = (
+            self.manifest.snapshot_interval if node.start_at == 0 else 0
+        )
+        self.app_procs[node.name] = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu.abci.server",
+             self.manifest.app, "--addr", addr,
+             "--transport", "grpc" if node.abci == "grpc" else "socket",
+             "--snapshot-interval", str(snapshot)],
+            stdout=logf, stderr=logf, env=self._node_env(),
+        )
+        deadline = time.time() + 15
+        while not os.path.exists(sock):
+            if self.app_procs[node.name].poll() is not None:
+                raise RuntimeError(f"{node.name}: ABCI app process died at start")
+            if time.time() > deadline:
+                raise TimeoutError(f"{node.name}: ABCI app socket never appeared")
+            time.sleep(0.05)
 
     def _launch(self, idx: int) -> subprocess.Popen:
+        node = self.manifest.nodes[idx]
+        if node.name not in self.app_procs or \
+           self.app_procs[node.name].poll() is not None:
+            self._launch_app(idx, node)
+        logf = self._open_log(idx)
         return subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cmd", "--home",
              os.path.join(self.home, f"node{idx}"), "start"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=logf, stderr=logf,
+            env=self._node_env(),
         )
 
     def start(self) -> None:
+        """Launch the genesis cohort; late joiners wait for their height."""
+        started = 0
         for i, node in enumerate(self.manifest.nodes):
-            self.procs[node.name] = self._launch(i)
-        self.log(f"started {len(self.procs)} nodes")
+            if node.start_at == 0:
+                self.procs[node.name] = self._launch(i)
+                started += 1
+        late = len(self.manifest.nodes) - started
+        self.log(f"started {started} nodes" + (f" ({late} join late)" if late else ""))
+
+    def _launch_late(self, idx: int, node: ManifestNode) -> None:
+        """runner/start.go second wave: wait for the net to reach the node's
+        start_at height, arm the statesync trust basis from live chain data,
+        then launch."""
+        first = self.manifest.nodes[0].name
+        self.wait_height(first, node.start_at)
+        if node.state_sync:
+            from cometbft_tpu.config import default_config
+            from cometbft_tpu.config.toml import load_toml, write_config_file
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            blk = HTTPClient(
+                f"http://127.0.0.1:{self.rpc_ports[first]}", timeout=5
+            ).block(1)
+            toml_path = os.path.join(
+                self.home, f"node{idx}", "config", "config.toml"
+            )
+            cfg = load_toml(toml_path, default_config())
+            cfg.statesync.trust_height = 1
+            cfg.statesync.trust_hash = blk["block_id"]["hash"]
+            write_config_file(toml_path, cfg)
+        self.log(f"late join {node.name} at height {node.start_at}"
+                 + (" (statesync)" if node.state_sync else " (blocksync)"))
+        self.procs[node.name] = self._launch(idx)
 
     # -- RPC helpers ------------------------------------------------------
 
@@ -219,7 +434,8 @@ class E2ERunner:
         # After every perturbation the node must make progress again.  The
         # heal window is generous: a stall grows consensus round timeouts
         # (the reference's per-round timeout deltas), so the first
-        # post-heal commit can take minutes after a partition.
+        # post-heal commit can take minutes after a partition.  Node 0 (a
+        # genesis validator by the ordering contract) is the reference.
         h = self.wait_height(self.manifest.nodes[0].name, 1)
         self.wait_height(name, h + 1, timeout=420)
         self.log(f"perturb {name}: {kind} healed")
@@ -249,6 +465,70 @@ class E2ERunner:
             if delay > 0:
                 time.sleep(delay)
 
+    # -- validator churn (test/e2e persistent_kvstore val: txs) -----------
+
+    def churn_validators(self) -> dict:
+        """Add a fresh ed25519 validator (power 1), wait for it to enter the
+        set, then vote it back out (power 0).  The extra validator never
+        runs a node — with equal powers the running cohort keeps quorum."""
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        first = self.manifest.nodes[0].name
+        cli = HTTPClient(
+            f"http://127.0.0.1:{self.rpc_ports[first]}", timeout=5
+        )
+        pub = ed25519.gen_priv_key().pub_key()
+        b64 = base64.b64encode(pub.bytes()).decode()
+
+        def tx_and_settle(power: int) -> None:
+            tx = f"val:{b64}!{power}".encode()
+            res = cli.call("broadcast_tx_sync", tx="0x" + tx.hex())
+            if int(res.get("code", 0)) != 0:
+                raise AssertionError(f"churn tx rejected: {res}")
+            h = self._height(first)
+            self.wait_height(first, h + 2)  # update lands at +1, active at +2
+
+        self.log(f"churn: adding validator {pub.address().hex()[:12]}…")
+        tx_and_settle(1)
+        n_now = len(cli.call("validators")["validators"])
+        self.log("churn: removing it again")
+        tx_and_settle(0)
+        n_after = len(cli.call("validators")["validators"])
+        if not (n_now == n_after + 1):
+            raise AssertionError(
+                f"validator churn did not round-trip: {n_now} -> {n_after}"
+            )
+        return {"added_then_removed": b64, "set_size": n_after}
+
+    # -- light client (runner/test.go + light package) --------------------
+
+    def verify_light_client(self, height: int) -> dict:
+        """Sequentially verify node 0's chain up to the agreed height with
+        the light client — the reference's evidence/light e2e leg."""
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.light.store import LightStore
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types import cmttime
+
+        first = self.manifest.nodes[0].name
+        url = f"http://127.0.0.1:{self.rpc_ports[first]}"
+        blk = HTTPClient(url, timeout=5).block(1)
+        trust = TrustOptions(
+            period_ns=int(3600 * 10**9),
+            height=1,
+            hash=bytes.fromhex(blk["block_id"]["hash"]),
+        )
+        primary = HTTPProvider("e2e-manifest", HTTPClient(url, timeout=5))
+        client = Client(
+            "e2e-manifest", trust, primary, [], LightStore(MemDB()),
+            skip_verification="sequential",
+        )
+        lb = client.verify_light_block_at_height(height, cmttime.now())
+        return {"height": lb.height, "hash": lb.hash().hex().upper()}
+
     # -- the run ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -260,10 +540,28 @@ class E2ERunner:
             first = self.manifest.nodes[0].name
             h0 = self.wait_height(first, self.manifest.initial_height + 2)
             pump.start()
+            churn_report = None
+            if self.manifest.validator_churn:
+                churn_report = self.churn_validators()
+            # Second start wave, in join order (runner/start.go sorts by
+            # start_at the same way).
+            late = sorted(
+                (
+                    (i, n)
+                    for i, n in enumerate(self.manifest.nodes)
+                    if n.start_at > 0
+                ),
+                key=lambda t: t[1].start_at,
+            )
+            for i, node in late:
+                self._launch_late(i, node)
             for node in self.manifest.nodes:
                 for kind in node.perturb:
                     self.perturb(node, kind)
-            target = h0 + self.manifest.target_blocks
+            target = max(
+                h0 + self.manifest.target_blocks,
+                max((n.start_at for n in self.manifest.nodes), default=0) + 2,
+            )
             heights = {
                 n.name: self.wait_height(n.name, target, timeout=420)
                 for n in self.manifest.nodes
@@ -280,18 +578,49 @@ class E2ERunner:
             }
             if len(set(hashes.values())) != 1:
                 raise AssertionError(f"hash disagreement at {common}: {hashes}")
+            light_report = None
+            if self.manifest.light_client:
+                light_report = self.verify_light_client(common)
+                if light_report["hash"].lower() != \
+                        next(iter(hashes.values())).lower():
+                    raise AssertionError(
+                        f"light client hash mismatch at {common}: "
+                        f"{light_report['hash']} vs {hashes}"
+                    )
             report = {
                 "nodes": len(self.manifest.nodes),
                 "perturbations": sum(len(n.perturb) for n in self.manifest.nodes),
+                "late_joins": len(late),
+                "backend": self.manifest.backend,
+                "app": self.manifest.app,
                 "final_heights": heights,
                 "agreed_height": common,
                 "agreed_hash": next(iter(hashes.values())),
             }
+            if churn_report is not None:
+                report["validator_churn"] = churn_report
+            if light_report is not None:
+                report["light_client"] = light_report
             self.log(json.dumps(report))
             return report
         finally:
             stop.set()
-            for proc in self.procs.values():
+            for proc in list(self.procs.values()) + list(self.app_procs.values()):
                 if proc.poll() is None:
                     proc.send_signal(signal.SIGKILL)
                     proc.wait()
+            for f in self._log_files:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    def node_logs(self) -> dict[str, str]:
+        """Per-node log paths (repro artifacts reference these)."""
+        out = {}
+        for i, node in enumerate(self.manifest.nodes):
+            for suffix in ("node", "app"):
+                p = os.path.join(self.home, f"node{i}", f"{suffix}.log")
+                if os.path.exists(p):
+                    out[f"{node.name}.{suffix}"] = p
+        return out

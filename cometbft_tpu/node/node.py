@@ -526,8 +526,18 @@ def default_new_node(config: Config, logger=None, app=None) -> Node:
         )
     if app is not None:
         creator = LocalClientCreator(app)
-    elif config.base.proxy_app in ("kvstore", "persistent_kvstore"):
-        creator = LocalClientCreator(KVStoreApplication())
+    elif config.base.proxy_app == "kvstore":
+        creator = LocalClientCreator(
+            KVStoreApplication(snapshot_interval=config.base.snapshot_interval)
+        )
+    elif config.base.proxy_app == "persistent_kvstore":
+        from cometbft_tpu.abci.example.kvstore import PersistentKVStoreApplication
+
+        creator = LocalClientCreator(
+            PersistentKVStoreApplication(
+                snapshot_interval=config.base.snapshot_interval
+            )
+        )
     elif config.base.proxy_app == "noop":
         from cometbft_tpu.abci import types as abci_types
 

@@ -27,13 +27,12 @@ import hmac
 import os
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto.compat import (
+    ChaCha20Poly1305,
     X25519PrivateKey,
     X25519PublicKey,
 )
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-
-from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.crypto.encoding import pub_key_from_proto, pub_key_to_proto
 from cometbft_tpu.crypto.merlin import Transcript
 from cometbft_tpu.wire import proto as wire
@@ -60,6 +59,27 @@ def _hkdf_sha256(secret: bytes, info: bytes, length: int) -> bytes:
         okm += t
         i += 1
     return okm[:length]
+
+
+def derive_secrets_and_challenge(
+    dh_secret: bytes, loc_is_least: bool
+) -> tuple[bytes, bytes, bytes]:
+    """deriveSecretsAndChallenge (secret_connection.go:335-360): 96 bytes of
+    HKDF output — two 32-byte AEAD keys ordered by which side sorts lower,
+    plus the legacy 32-byte challenge in the tail.  Returns
+    (recv_secret, send_secret, challenge).
+
+    The handshake authenticates with the merlin transcript challenge (see
+    _handshake), not this HKDF tail, but the key halves here are exactly
+    what the live handshake uses — and the whole triple is pinned by the
+    reference's TestDeriveSecretsAndChallengeGolden vectors."""
+    okm = _hkdf_sha256(dh_secret, KEY_AND_CHALLENGE_GEN, 96)
+    challenge = okm[64:96]
+    if loc_is_least:
+        recv_secret, send_secret = okm[:32], okm[32:64]
+    else:
+        send_secret, recv_secret = okm[:32], okm[32:64]
+    return recv_secret, send_secret, challenge
 
 
 class SecretConnection:
@@ -93,11 +113,9 @@ class SecretConnection:
         transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
         dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
         transcript.append_message(b"DH_SECRET", dh_secret)
-        okm = _hkdf_sha256(dh_secret, KEY_AND_CHALLENGE_GEN, 96)
-        if loc_is_least:
-            recv_secret, send_secret = okm[:32], okm[32:64]
-        else:
-            send_secret, recv_secret = okm[:32], okm[32:64]
+        recv_secret, send_secret, _ = derive_secrets_and_challenge(
+            dh_secret, loc_is_least
+        )
         challenge = transcript.extract_bytes(b"SECRET_CONNECTION_MAC", 32)
         self._send_aead = ChaCha20Poly1305(send_secret)
         self._recv_aead = ChaCha20Poly1305(recv_secret)

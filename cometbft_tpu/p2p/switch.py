@@ -17,8 +17,13 @@ from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from cometbft_tpu.p2p.node_info import NodeInfo
 from cometbft_tpu.p2p.transport import MultiplexTransport, UpgradedConn
 
-# Redial schedule (switch.go reconnectToPeer shape): 20 linear attempts at
-# ~1 s, then exponential doubling capped at 60 s, all with +/-20% jitter.
+# Redial schedule — INTENTIONAL DIVERGENCE from the reference constants.
+# switch.go:25-31 reconnectToPeer does 20 linear attempts at 5 s, then 3^i
+# exponential backoff, and gives up after a finite attempt budget.  Here:
+# 20 linear attempts at 1 s, then 2^i doubling capped at 60 s, retrying
+# FOREVER (+/-20% jitter on every sleep).  Giving up permanently on a
+# persistent peer costs liveness on small loopback testnets (a healed
+# partition must always be redialed), so only the two-phase shape is kept.
 REDIAL_LINEAR_ATTEMPTS = 20
 REDIAL_LINEAR_SLEEP_S = 1.0
 REDIAL_MAX_SLEEP_S = 60.0

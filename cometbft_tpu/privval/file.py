@@ -16,7 +16,7 @@ import os
 import tempfile
 from dataclasses import dataclass, field as dfield, replace
 
-from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto import bn254, ed25519, secp256k1, sr25519
 from cometbft_tpu.types.block import PRECOMMIT_TYPE, PREVOTE_TYPE, PROPOSAL_TYPE
 from cometbft_tpu.types.cmttime import Time
 from cometbft_tpu.types.priv_validator import PrivValidator
@@ -37,6 +37,15 @@ _TYPE_TO_STEP = {
 
 class DoubleSignError(Exception):
     pass
+
+
+# Key-type registry (reference: privval/file.go GenFilePV takes a keyType
+# string routed through privval.GenFilePV -> crypto keygen; the JSON names
+# are the amino-era type tags each crypto package registers).
+_KEY_MODULES = (ed25519, secp256k1, sr25519, bn254)
+KEY_TYPES = tuple(m.KEY_TYPE for m in _KEY_MODULES)
+_BY_KEY_TYPE = {m.KEY_TYPE: m for m in _KEY_MODULES}
+_BY_PRIV_NAME = {m.PRIV_KEY_NAME: m for m in _KEY_MODULES}
 
 
 @dataclass
@@ -118,39 +127,60 @@ class FilePV(PrivValidator):
     # -- construction / persistence ------------------------------------------
 
     @classmethod
-    def generate(cls, key_file_path: str = "", state_file_path: str = "") -> "FilePV":
-        return cls(ed25519.gen_priv_key(), key_file_path, state_file_path)
+    def generate(
+        cls,
+        key_file_path: str = "",
+        state_file_path: str = "",
+        key_type: str = ed25519.KEY_TYPE,
+    ) -> "FilePV":
+        """privval/file.go GenFilePV: fresh key of the requested type."""
+        mod = _BY_KEY_TYPE.get(key_type)
+        if mod is None:
+            raise ValueError(
+                f"unsupported privval key type {key_type!r} (want one of {KEY_TYPES})"
+            )
+        return cls(mod.gen_priv_key(), key_file_path, state_file_path)
 
     @classmethod
     def load(cls, key_file_path: str, state_file_path: str) -> "FilePV":
         with open(key_file_path) as f:
             d = json.load(f)
+        name = d["priv_key"].get("type", ed25519.PRIV_KEY_NAME)
+        mod = _BY_PRIV_NAME.get(name)
+        if mod is None:
+            raise ValueError(f"unknown priv_key type {name!r} in {key_file_path}")
         priv_raw = base64.b64decode(d["priv_key"]["value"])
-        pv = cls(ed25519.PrivKey(priv_raw), key_file_path, state_file_path)
+        pv = cls(mod.PrivKey(priv_raw), key_file_path, state_file_path)
         if os.path.exists(state_file_path):
             pv.last_sign_state = LastSignState.load(state_file_path)
             pv.last_sign_state.file_path = state_file_path
         return pv
 
     @classmethod
-    def load_or_generate(cls, key_file_path: str, state_file_path: str) -> "FilePV":
+    def load_or_generate(
+        cls,
+        key_file_path: str,
+        state_file_path: str,
+        key_type: str = ed25519.KEY_TYPE,
+    ) -> "FilePV":
         if os.path.exists(key_file_path):
             return cls.load(key_file_path, state_file_path)
-        pv = cls.generate(key_file_path, state_file_path)
+        pv = cls.generate(key_file_path, state_file_path, key_type=key_type)
         pv.save()
         return pv
 
     def save(self) -> None:
         pub = self.priv_key.pub_key()
+        mod = _BY_KEY_TYPE[self.priv_key.type()]
         data = json.dumps(
             {
                 "address": pub.address().hex().upper(),
                 "pub_key": {
-                    "type": "tendermint/PubKeyEd25519",
+                    "type": mod.PUB_KEY_NAME,
                     "value": base64.b64encode(pub.bytes()).decode(),
                 },
                 "priv_key": {
-                    "type": "tendermint/PrivKeyEd25519",
+                    "type": mod.PRIV_KEY_NAME,
                     "value": base64.b64encode(self.priv_key.bytes()).decode(),
                 },
             },

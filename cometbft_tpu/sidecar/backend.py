@@ -165,12 +165,19 @@ class HybridBackend(VerifyBackend):
         minimizing predicted max(device time, host time)."""
         from cometbft_tpu.ops import ed25519_kernel as ek
 
+        # Snapshot under the lock: _update_rates inserts first-observation
+        # bucket keys from straggler-collect threads, and iterating the live
+        # dict here would race that insert (RuntimeError: dictionary changed
+        # size during iteration) escaping into consensus/blocksync callers.
+        with self._rate_lock:
+            walls = dict(self._dev_wall)
+
         def dev_ms(b):  # padded lanes compute like real ones
             bucket = ek.bucket_for(b)
-            wall = self._dev_wall.get(bucket)
+            wall = walls.get(bucket)
             if wall is not None:
                 return wall
-            obs = sorted(self._dev_wall.items())
+            obs = sorted(walls.items())
             if len(obs) >= 2:
                 # affine fit over the widest observed span
                 (b1, w1), (b2, w2) = obs[0], obs[-1]

@@ -188,6 +188,9 @@ class TestSymmetric:
         import os
         import struct
 
+        pytest.importorskip(
+            "cryptography", reason="cross-check needs OpenSSL's ChaCha20 core"
+        )
         from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
 
         for _ in range(4):

@@ -1,0 +1,199 @@
+"""Seeded testnet generator (reference: test/e2e/generator): determinism,
+schema validity across the seed space, profile constraints, and the
+matrix sweep's repro artifact + smoke run."""
+
+import json
+import os
+
+import pytest
+
+from cometbft_tpu.e2e_generator import (
+    PROFILES,
+    generate,
+    generate_spec,
+    run_matrix,
+)
+from cometbft_tpu.e2e_runner import Manifest
+
+
+def test_generate_is_deterministic():
+    """Byte-identical output per (seed, profile) — the repro contract."""
+    for seed in range(50):
+        for profile in PROFILES:
+            assert generate(seed, profile) == generate(seed, profile)
+
+
+def test_generate_varies_across_seeds():
+    outputs = {generate(seed, "full") for seed in range(50)}
+    assert len(outputs) == 50, "seeds must explore the sampling space"
+
+
+def test_generated_manifests_validate(tmp_path):
+    """Every generated manifest must satisfy the runner's own schema."""
+    for seed in range(60):
+        for profile in PROFILES:
+            p = tmp_path / f"{profile}-{seed}.toml"
+            p.write_text(generate(seed, profile))
+            m = Manifest.load(str(p))
+            assert m.seed == seed
+            first = m.nodes[0]
+            assert first.is_validator() and first.start_at == 0
+            for n in m.nodes:
+                if n.state_sync:
+                    assert m.snapshot_interval > 0
+            assert not m.nodes[0].perturb, "node 0 is the heal reference"
+
+
+def test_full_profile_reaches_every_dimension():
+    """Across a modest seed range the sampler must hit each axis at least
+    once — a silent constant would hollow out the matrix."""
+    specs = [generate_spec(seed, "full") for seed in range(200)]
+    assert any(s["backend"] == "hybrid" for s in specs)
+    assert any(s["validator_churn"] for s in specs)
+    assert any(s["light_client"] for s in specs)
+    assert any(s["snapshot_interval"] > 0 for s in specs)
+    nodes = [n for s in specs for n in s["nodes"]]
+    assert any(n["state_sync"] for n in nodes)
+    assert any(n["start_at"] > 0 and n["mode"] == "validator" for n in nodes)
+    assert any(n["mode"] == "seed" for n in nodes)
+    assert any(n["abci"] == "socket" for n in nodes)
+    assert any(n["abci"] == "grpc" for n in nodes)
+    for kt in ("ed25519", "secp256k1", "sr25519", "bn254"):
+        assert any(n["key_type"] == kt for n in nodes), kt
+    for p in ("kill", "pause", "disconnect", "restart"):
+        assert any(p in n["perturb"] for n in nodes), p
+
+
+def test_small_profile_stays_small():
+    """The CI-sized corner: ≤4 validators, ≤6 blocks, ≤1 perturbation,
+    ed25519-only, cpu backend, no statesync."""
+    for seed in range(80):
+        s = generate_spec(seed, "small")
+        assert sum(1 for n in s["nodes"] if n["mode"] == "validator") <= 4
+        assert s["target_blocks"] <= 6
+        assert sum(len(n["perturb"]) for n in s["nodes"]) <= 1
+        assert s["backend"] == "cpu"
+        assert all(n["key_type"] == "ed25519" for n in s["nodes"])
+        assert all(not n["state_sync"] for n in s["nodes"])
+        assert all(n["mode"] != "seed" for n in s["nodes"])
+
+
+def test_quorum_constraint_on_late_validators():
+    """Genesis-online validators always hold > 2/3 of the equal-power set."""
+    for seed in range(150):
+        s = generate_spec(seed, "full")
+        vals = [n for n in s["nodes"] if n["mode"] == "validator"]
+        late = [n for n in vals if n["start_at"] > 0]
+        assert 3 * (len(vals) - len(late)) > 2 * len(vals)
+
+
+def test_cli_seed_spec_parsing():
+    from cometbft_tpu.cmd.__main__ import _parse_seeds
+
+    assert _parse_seeds("7") == [7]
+    assert _parse_seeds("0..3") == [0, 1, 2, 3]
+    assert _parse_seeds("5, 9,1..2") == [5, 9, 1, 2]
+    with pytest.raises(ValueError):
+        _parse_seeds("")
+
+
+class _ExplodingRunner:
+    """Stands in for E2ERunner: fails like a mid-run hash disagreement."""
+
+    def __init__(self, manifest_path, home, log=print):
+        self.manifest_path = manifest_path
+        self.home = home
+        os.makedirs(os.path.join(home, "node0"), exist_ok=True)
+        self._log = os.path.join(home, "node0", "node.log")
+        with open(self._log, "w") as f:
+            f.write("panic: hash mismatch at height 5\n")
+
+    def run(self):
+        raise AssertionError("hash disagreement at 5: {...}")
+
+    def node_logs(self):
+        return {"validator01.node": self._log}
+
+
+def test_matrix_failure_writes_repro_artifact(tmp_path):
+    summary = run_matrix(
+        [7], str(tmp_path), profile="small",
+        runner_cls=_ExplodingRunner, log=lambda s: None,
+    )
+    assert summary["failed"] == [7] and summary["passed"] == []
+    repro_path = summary["results"]["7"]["repro"]
+    assert os.path.exists(repro_path)
+    with open(repro_path) as f:
+        repro = json.load(f)
+    assert repro["seed"] == 7
+    assert repro["manifest"] == generate(7, "small")
+    assert "hash disagreement" in repro["error"]
+    assert "--seed 7" in repro["regenerate"]
+    assert "hash mismatch" in repro["node_logs"]["validator01.node"]["tail"]
+    # The frozen manifest alone must reload into a valid runner config.
+    frozen = tmp_path / "frozen.toml"
+    frozen.write_text(repro["manifest"])
+    Manifest.load(str(frozen))
+
+
+class _RecordingRunner:
+    seen: list = []
+
+    def __init__(self, manifest_path, home, log=print):
+        self.manifest = Manifest.load(manifest_path)
+
+    def run(self):
+        _RecordingRunner.seen.append(self.manifest.seed)
+        return {"agreed_height": 5, "nodes": len(self.manifest.nodes)}
+
+    def node_logs(self):
+        return {}
+
+
+def test_matrix_runs_every_seed(tmp_path):
+    _RecordingRunner.seen = []
+    summary = run_matrix(
+        [1, 2, 3], str(tmp_path), profile="small",
+        runner_cls=_RecordingRunner, log=lambda s: None,
+    )
+    assert _RecordingRunner.seen == [1, 2, 3]
+    assert summary["passed"] == [1, 2, 3] and summary["failed"] == []
+    for seed in (1, 2, 3):
+        assert os.path.exists(tmp_path / f"seed{seed}" / "manifest.toml")
+
+
+def _seeds_with(profile, want, n=500):
+    """First seeds whose generated spec satisfies a predicate."""
+    out = []
+    for seed in range(n):
+        if want(generate_spec(seed, profile)):
+            out.append(seed)
+    return out
+
+
+@pytest.mark.slow
+def test_matrix_smoke(tmp_path):
+    """Three small seeds end-to-end through the real runner: every run must
+    reach its target and agree on one block hash (the matrix acceptance
+    bar).  Prefers seeds that exercise a late join and an external ABCI
+    boundary so the smoke covers more than the trivial corner."""
+    late = _seeds_with(
+        "small", lambda s: any(n["start_at"] > 0 for n in s["nodes"])
+    )
+    ext = _seeds_with(
+        "small", lambda s: any(n["abci"] != "local" for n in s["nodes"])
+    )
+    seeds = []
+    for pool in (late, ext, range(500)):
+        for s in pool:
+            if s not in seeds:
+                seeds.append(s)
+                break
+    assert len(seeds) == 3
+    summary = run_matrix(
+        seeds, str(tmp_path), profile="small", log=lambda s: None
+    )
+    assert summary["failed"] == [], summary
+    for seed in seeds:
+        rep = summary["results"][str(seed)]["report"]
+        assert len(rep["agreed_hash"]) == 64

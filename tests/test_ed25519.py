@@ -141,3 +141,20 @@ def test_pure_batch_equation():
     sigs[2] = sigs[2][:32] + bytes(32)
     ok, res = ed25519_pure.batch_verify_zip215(pubs, msgs, sigs)
     assert not ok and res == [True, True, False, True]
+
+
+def test_verify_accepts_byteslike_signature():
+    """The verified-triple cache key must coerce the signature like it
+    coerces the message: a bytearray/memoryview sig previously raised
+    TypeError (unhashable) at the cache lookup instead of verifying."""
+    priv = ed25519.gen_priv_key()
+    pub = priv.pub_key()
+    msg = b"bytes-like sig"
+    sig = priv.sign(msg)
+    assert pub.verify_signature(msg, bytearray(sig))
+    assert pub.verify_signature(bytearray(msg), memoryview(sig))
+    # The cached triple serves the bytes form of the same signature too.
+    assert pub.verify_signature(msg, sig)
+    bad = bytearray(sig)
+    bad[3] ^= 0x40
+    assert not pub.verify_signature(msg, bad)

@@ -189,3 +189,43 @@ def test_multi_device_routing_shards_the_shipped_seam(monkeypatch):
     assert not ok
     assert [i for i, b in enumerate(bits) if not b] == [7, 40]
     assert called.get("sharded"), "batch_verify did not route via the mesh"
+
+
+def test_plan_snapshots_dev_wall_under_rate_lock(monkeypatch):
+    """_plan races _update_rates: straggler-collect threads insert
+    first-observation bucket keys into _dev_wall under _rate_lock while
+    _plan iterates the model.  The plan must work from a locked snapshot —
+    regression for RuntimeError('dictionary changed size during iteration')
+    escaping batch_verify into consensus/blocksync callers."""
+    import threading
+
+    hb = _hybrid(monkeypatch)
+    stop = threading.Event()
+    failures = []
+
+    def writer():
+        # Same access pattern as _update_rates: mutate only under the lock,
+        # churning keys so an unlocked iteration over the live dict would
+        # observe size changes.
+        k = 0
+        while not stop.is_set():
+            k += 1
+            with hb._rate_lock:
+                hb._dev_wall[128 * (k % 64 + 1)] = 1.0 + (k % 7)
+                if k % 5 == 0:
+                    hb._dev_wall.pop(128 * ((k * 31) % 64 + 1), None)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(2000):
+            try:
+                share = hb._plan(4096)
+            except RuntimeError as e:  # the exact pre-fix failure mode
+                failures.append(e)
+                break
+            assert share >= 0
+    finally:
+        stop.set()
+        t.join(timeout=2)
+    assert not failures, f"_plan raced the rate model: {failures[0]}"

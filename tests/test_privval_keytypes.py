@@ -1,0 +1,82 @@
+"""FilePV across consensus key types (reference: privval/file.go GenFilePV
+keyType routing + testnet.go --key-type): generate/save/load round-trips,
+JSON type-name dispatch, and the testnet CLI's cycled --key-types layout."""
+
+import json
+import os
+
+import pytest
+
+from cometbft_tpu.cmd.__main__ import main as cli
+from cometbft_tpu.privval.file import KEY_TYPES, FilePV
+
+
+@pytest.mark.parametrize("key_type", KEY_TYPES)
+def test_filepv_roundtrip_per_key_type(tmp_path, key_type):
+    key_file = str(tmp_path / "key.json")
+    state_file = str(tmp_path / "state.json")
+    pv = FilePV.generate(key_file, state_file, key_type=key_type)
+    pv.save()
+    with open(key_file) as f:
+        d = json.load(f)
+    assert d["priv_key"]["type"].startswith("tendermint/PrivKey")
+    assert d["pub_key"]["type"].startswith("tendermint/PubKey")
+    # The persisted names must dispatch back to the same key type.
+    loaded = FilePV.load(key_file, state_file)
+    assert loaded.priv_key.type() == key_type
+    assert loaded.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    sig = loaded.priv_key.sign(b"msg")
+    assert loaded.get_pub_key().verify_signature(b"msg", sig)
+
+
+def test_filepv_rejects_unknown_key_type(tmp_path):
+    with pytest.raises(ValueError, match="unsupported privval key type"):
+        FilePV.generate(str(tmp_path / "k"), str(tmp_path / "s"),
+                        key_type="dilithium")
+
+
+def test_filepv_legacy_file_without_type_defaults_to_ed25519(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    pv.save()
+    with open(tmp_path / "k.json") as f:
+        d = json.load(f)
+    del d["priv_key"]["type"]
+    (tmp_path / "k.json").write_text(json.dumps(d))
+    loaded = FilePV.load(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    assert loaded.priv_key.type() == "ed25519"
+
+
+def test_testnet_cycles_key_types_and_non_validators(tmp_path):
+    out = str(tmp_path / "net")
+    assert cli([
+        "testnet", "--validators", "3", "--non-validators", "2",
+        "--key-types", "ed25519,secp256k1,sr25519",
+        "--output-dir", out, "--chain-id", "kt-net",
+    ]) == 0
+    expect = ["ed25519", "secp256k1", "sr25519", "ed25519", "secp256k1"]
+    pvs = []
+    for i, want in enumerate(expect):
+        home = os.path.join(out, f"node{i}")
+        pv = FilePV.load(
+            os.path.join(home, "config", "priv_validator_key.json"),
+            os.path.join(home, "data", "priv_validator_state.json"),
+        )
+        assert pv.priv_key.type() == want, f"node{i}"
+        pvs.append(pv)
+    with open(os.path.join(out, "node0", "config", "genesis.json")) as f:
+        genesis = json.load(f)
+    # Only the first 3 homes are genesis validators; all 5 share the doc.
+    assert len(genesis["validators"]) == 3
+    genesis_addrs = {v["address"] for v in genesis["validators"]}
+    assert genesis_addrs == {
+        pv.get_pub_key().address().hex().upper() for pv in pvs[:3]
+    }
+    with open(os.path.join(out, "node4", "config", "genesis.json")) as f:
+        assert json.load(f) == genesis
+
+
+def test_testnet_rejects_unknown_key_type(tmp_path, capsys):
+    assert cli([
+        "testnet", "--validators", "1", "--key-types", "rsa4096",
+        "--output-dir", str(tmp_path / "x"),
+    ]) == 1
