@@ -121,6 +121,18 @@ def cmd_devnet(args) -> int:
     # the middle of a live consensus round would stall block production.
     # Opt into the device tier with --backend tpu (pre-warms before starting).
     os.environ.setdefault("CMTPU_BACKEND", args.backend)
+    if getattr(args, "faults", None):
+        # Chaos devnet: inject seeded backend faults and let the supervised
+        # chain (CMTPU_BACKEND=auto is the only mode that supervises) prove
+        # the devnet keeps committing through them.
+        from cometbft_tpu.sidecar.chaos import parse_faults
+
+        parse_faults(args.faults)  # fail on a bad spec before boot, not mid-run
+        os.environ["CMTPU_BACKEND"] = "auto"
+        os.environ["CMTPU_FAULTS"] = args.faults
+        os.environ.setdefault("CMTPU_FAULTS_SEED", "0")
+        os.environ.setdefault("CMTPU_DEADLINE_MS", "2000")
+        print(f"devnet: backend faults armed ({args.faults}), supervised auto chain")
     if os.environ["CMTPU_BACKEND"] == "tpu":
         from cometbft_tpu.ops import ed25519_kernel as _ek
 
@@ -187,6 +199,11 @@ def cmd_devnet(args) -> int:
     for node in nodes:
         node.stop()
     print(f"devnet done at height {cs0.rs.height - 1}")
+    from cometbft_tpu.sidecar import backend as _backend_mod
+
+    live = _backend_mod._backend
+    if live is not None and hasattr(live, "counters"):
+        print(f"backend counters: {live.counters()}")
     return 0
 
 
@@ -605,7 +622,14 @@ def main(argv=None) -> int:
     sp.add_argument("--blocks", type=int, default=10)
     sp.add_argument("--rpc-port", type=int, default=26657)
     sp.add_argument("--block-interval", type=float, default=1.0)
-    sp.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "auto"])
+    sp.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "hybrid", "auto"])
+    sp.add_argument(
+        "--faults",
+        default=None,
+        metavar="SPEC",
+        help="chaos devnet: CMTPU_FAULTS spec (latency:p:ms,error:p,wedge:p,"
+        "flip:p) injected into the supervised auto backend chain",
+    )
     sp = sub.add_parser("light")
     sp.add_argument("chain_id")
     sp.add_argument("--primary", required=True, help="primary node RPC URL")

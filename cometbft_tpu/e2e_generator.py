@@ -13,7 +13,9 @@ Profiles mirror the reference's groups:
 * ``full`` — the whole sampling space: up to 6 validators plus full/seed
   nodes, mixed consensus key types, socket/grpc ABCI boundaries, late
   joins via blocksync or verified statesync, validator churn, hybrid
-  backend, any perturbation.
+  backend, any perturbation — including ``backend_faults``, which
+  restarts a node with a chaos-injected supervised verification chain
+  (CMTPU_FAULTS, sidecar/chaos.py) and demands it keeps committing.
 * ``small`` — the CI-sized corner (≤4 validators, ≤6 target blocks, ≤1
   perturbation, ed25519 only, cpu backend): what ``e2e matrix`` smokes in
   the test tier.
@@ -45,8 +47,8 @@ _KEY_TYPES_FULL = (
 )
 _ABCI_FULL = ("local",) * 5 + ("socket",) * 3 + ("grpc",) * 2
 _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
-_PERTURB_FULL = ("kill", "pause", "disconnect", "restart")
-_PERTURB_SMALL = ("pause", "restart")
+_PERTURB_FULL = ("kill", "pause", "disconnect", "restart", "backend_faults")
+_PERTURB_SMALL = ("pause", "restart", "backend_faults")
 
 
 def generate(seed: int, profile: str = "full") -> str:

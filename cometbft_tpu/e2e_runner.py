@@ -3,8 +3,10 @@ test/e2e/runner).
 
 The reference drives docker-compose testnets from a TOML manifest: node
 topology, per-node perturbation schedules (kill / pause / disconnect /
-restart), transaction load, then a liveness + hash-agreement check and an
-optional benchmark report.  This is that runner over OS processes on
+restart — plus this framework's own ``backend_faults``, which restarts a
+node with a chaos-injected supervised verification chain), transaction
+load, then a liveness + hash-agreement check and an optional benchmark
+report.  This is that runner over OS processes on
 loopback (the deployment substrate this framework's e2e tier uses —
 tests/test_e2e_processes.py holds the individual perturbations to their
 semantics; this module sequences them from a manifest).
@@ -57,7 +59,7 @@ from cometbft_tpu.libs import tomlcompat as tomllib
 
 MODES = ("validator", "full", "seed")
 ABCI_MODES = ("local", "socket", "grpc")
-PERTURBATIONS = ("kill", "pause", "disconnect", "restart")
+PERTURBATIONS = ("kill", "pause", "disconnect", "restart", "backend_faults")
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
 
@@ -186,6 +188,9 @@ class E2ERunner:
         self.rpc_ports: dict[str, int] = {}
         self.p2p_ports: dict[str, int] = {}
         self._log_files: list = []
+        # Nodes whose verification backend runs fault-injected (the
+        # backend_faults perturbation arms this before relaunch).
+        self._fault_armed: set[str] = set()
 
     # -- setup ------------------------------------------------------------
 
@@ -316,17 +321,37 @@ class E2ERunner:
                 raise TimeoutError(f"{node.name}: ABCI app socket never appeared")
             time.sleep(0.05)
 
+    def _fault_env(self, idx: int) -> dict:
+        """The backend_faults environment: a supervised (CMTPU_BACKEND=auto)
+        chain whose primary tier injects deterministic latency + errors
+        (sidecar/chaos.py), seeded from the manifest seed + node index so a
+        failing seed reproduces its exact fault sequence.  Probabilities
+        stay moderate — the point is degrading THROUGH faults, not a dead
+        node — and the anchor tier is always clean."""
+        seed = max(self.manifest.seed, 0) * 1000 + idx
+        return {
+            "CMTPU_BACKEND": "auto",
+            "CMTPU_FAULTS": "latency:0.2:25,error:0.25",
+            "CMTPU_FAULTS_SEED": str(seed),
+            "CMTPU_DEADLINE_MS": "2000",
+            "CMTPU_BACKOFF_MS": "10",
+            "CMTPU_BREAKER_COOLDOWN_MS": "2000",
+        }
+
     def _launch(self, idx: int) -> subprocess.Popen:
         node = self.manifest.nodes[idx]
         if node.name not in self.app_procs or \
            self.app_procs[node.name].poll() is not None:
             self._launch_app(idx, node)
         logf = self._open_log(idx)
+        env = self._node_env()
+        if node.name in self._fault_armed:
+            env.update(self._fault_env(idx))
         return subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cmd", "--home",
              os.path.join(self.home, f"node{idx}"), "start"],
             stdout=logf, stderr=logf,
-            env=self._node_env(),
+            env=env,
         )
 
     def start(self) -> None:
@@ -395,6 +420,16 @@ class E2ERunner:
         proc = self.procs[name]
         self.log(f"perturb {name}: {kind}")
         if kind == "kill" or kind == "restart":
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+        elif kind == "backend_faults":
+            # Relaunch with a fault-injected supervised verification chain
+            # (stays armed for the rest of the run): the heal check below
+            # proves the node keeps committing while its primary tier
+            # throws injected errors and latency.
+            self._fault_armed.add(name)
             proc.send_signal(signal.SIGKILL)
             proc.wait()
             time.sleep(1.0)
@@ -597,6 +632,8 @@ class E2ERunner:
                 "agreed_height": common,
                 "agreed_hash": next(iter(hashes.values())),
             }
+            if self._fault_armed:
+                report["backend_faults"] = sorted(self._fault_armed)
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

@@ -164,6 +164,7 @@ class Node:
                            lambda: self.block_store.height())
             reg.gauge_func("blockstore", "base", "Block store base height.",
                            lambda: self.block_store.base())
+            self._register_backend_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
             host, _, port = addr.rpartition(":")
             self.metrics_server = MetricsServer(
@@ -284,6 +285,43 @@ class Node:
         self.rpc_server = None
         self.grpc_server = None
         self._rpc_env = None
+
+    @staticmethod
+    def _register_backend_metrics(reg) -> None:
+        """backend_trips / backend_retries / backend_deadline_exceeded /
+        backend_active_tier gauges, sampled lazily off the process-wide
+        verification backend.  Sampling (not registering) checks for the
+        supervisor so scraping never forces backend construction — under
+        CMTPU_BACKEND=auto with an accelerator visible that would import
+        jax at node boot instead of first verification."""
+        from cometbft_tpu.sidecar import backend as backend_mod
+
+        def sample(key):
+            def fn():
+                b = backend_mod._backend  # no get_backend(): never constructs
+                counters = getattr(b, "counters", None)
+                if counters is None:
+                    return 0
+                c = counters()
+                if key == "active_tier":
+                    return b.active_tier_index
+                return c.get(key, 0)
+
+            return fn
+
+        reg.gauge_func("backend", "trips",
+                       "Verification-tier circuit-breaker trips.",
+                       sample("trips"))
+        reg.gauge_func("backend", "retries",
+                       "Verification-tier transient-error retries.",
+                       sample("retries"))
+        reg.gauge_func("backend", "deadline_exceeded",
+                       "Verification calls past CMTPU_DEADLINE_MS.",
+                       sample("deadline_exceeded"))
+        reg.gauge_func("backend", "active_tier",
+                       "Degradation-chain index of the serving tier "
+                       "(0 = primary).",
+                       sample("active_tier"))
 
     # -- lifecycle ------------------------------------------------------------
 

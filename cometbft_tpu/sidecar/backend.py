@@ -7,9 +7,13 @@ Selection (env `CMTPU_BACKEND`, default `auto`):
               bucket-aligned split of each large batch, small batches routed
               to whichever tier's cost model wins
   - `grpc`:   remote verification sidecar over gRPC (sidecar/service.py)
-  - `auto`:   `hybrid` whenever a JAX accelerator is visible (it degrades
+  - `auto`:   the SUPERVISED degradation chain (sidecar/supervisor.py):
+              `grpc|tpu -> hybrid -> cpu` with per-call deadlines, bounded
+              retry and per-tier circuit breakers. The device tier is
+              `hybrid` whenever a JAX accelerator is visible (it degrades
               per-call to device-only until/unless the native library
-              builds, so selection never blocks on gcc), else `cpu`
+              builds, so selection never blocks on gcc), else the chain is
+              cpu-only.
 
 This mirrors where the reference chooses batch vs single verification
 (types/validation.go:14-16, 43-50): the caller keeps its fallback path, the
@@ -19,8 +23,20 @@ backend only changes who executes the batch.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
+
+_fallback_logged = False
+
+
+def _log_fallback(reason: str) -> None:
+    """One stderr line at selection time, first fallback only: the old bare
+    `except Exception: pass` swallowed WHY a host silently ran cpu-only."""
+    global _fallback_logged
+    if not _fallback_logged:
+        _fallback_logged = True
+        print(f"backend: auto -> cpu ({reason})", file=sys.stderr, flush=True)
 
 
 class VerifyBackend:
@@ -379,7 +395,10 @@ def device_backend(choice: str = "auto") -> VerifyBackend:
         return CpuBackend()
     try:
         import jax
-
+    except ImportError as e:
+        _log_fallback(f"jax not importable: {e}")
+        return CpuBackend()
+    try:
         if want:
             jax.config.update("jax_platforms", want)
         if any(d.platform != "cpu" for d in jax.devices()):
@@ -387,8 +406,12 @@ def device_backend(choice: str = "auto") -> VerifyBackend:
             # native build is unavailable, so select it without blocking on
             # native.available()'s gcc run (first-call-stall discipline).
             return HybridBackend()
-    except Exception:
-        pass
+    except (RuntimeError, OSError, ValueError) as e:
+        # Device-probe failures only (no PJRT backend, plugin init error,
+        # bad platform name). Anything else — a real bug in a tier's
+        # constructor — propagates instead of silently degrading.
+        _log_fallback(f"device probe failed: {type(e).__name__}: {e}")
+        return CpuBackend()
     return CpuBackend()
 
 
@@ -400,6 +423,15 @@ def _make_backend() -> VerifyBackend:
         return GrpcBackend(os.environ.get("CMTPU_SIDECAR_ADDR", "127.0.0.1:26670"))
     if choice not in ("auto", "cpu", "tpu", "hybrid"):
         raise ValueError(f"unknown CMTPU_BACKEND {choice!r}")
+    if choice == "auto":
+        # auto ships the supervised degradation chain (grpc|tpu -> hybrid
+        # -> cpu with deadlines + circuit breakers, sidecar/supervisor.py):
+        # a wedged tier costs one CMTPU_DEADLINE_MS, never liveness.
+        # Explicit single-tier choices stay bare — forcing `tpu` or `grpc`
+        # means "fail loudly", not "silently verify somewhere else".
+        from cometbft_tpu.sidecar.supervisor import build_resilient
+
+        return build_resilient()
     return device_backend(choice)
 
 

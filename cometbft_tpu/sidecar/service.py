@@ -33,10 +33,12 @@ clients; see tpu_watch.sh / memory notes).
 from __future__ import annotations
 
 import os
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from cometbft_tpu.sidecar.backend import TpuBackend, VerifyBackend, device_backend
 from cometbft_tpu.wire import proto
@@ -201,9 +203,23 @@ class GrpcBackend(VerifyBackend):
 
     name = "grpc"
 
-    def __init__(self, addr: str = DEFAULT_ADDR, timeout_s: float = 300.0):
+    # Redial backoff bounds: first failure waits _REDIAL_BASE_S, doubling
+    # (with jitter inside the doubling) to the _REDIAL_MAX_S cap.
+    _REDIAL_BASE_S = 0.05
+    _REDIAL_MAX_S = 5.0
+
+    def __init__(
+        self,
+        addr: str = DEFAULT_ADDR,
+        timeout_s: float = 300.0,
+        connect_timeout_s: float = 5.0,
+    ):
+        # timeout_s is the per-REQUEST deadline (slot wait below);
+        # connect_timeout_s bounds dial time only. One 300 s knob doing
+        # both meant a dead relay cost five minutes per connect attempt.
         self.addr = addr
         self.timeout_s = timeout_s
+        self.connect_timeout_s = connect_timeout_s
         self._sock: socket.socket | None = None
         self._wlock = threading.Lock()  # serializes frame WRITES only
         self._plock = threading.Lock()  # connection + pending table
@@ -211,10 +227,38 @@ class GrpcBackend(VerifyBackend):
         # dead connection's reader sweep fail ONLY its own waiters.
         self._pending: dict[int, list] = {}
         self._next_id = 0
+        # Capped redial-with-backoff (under _plock): a client object used
+        # to die for good once the sidecar went away; now each failed dial
+        # opens a backoff window in which calls fail FAST, and the next
+        # call after the window redials.
+        self._redial_failures = 0
+        self._redial_not_before = 0.0
 
     def _connect_locked(self) -> None:
+        now = time.monotonic()
+        if self._redial_failures and now < self._redial_not_before:
+            raise ConnectionError(
+                f"sidecar {self.addr} in redial backoff "
+                f"({self._redial_failures} consecutive dial failures)"
+            )
         host, port = self.addr.rsplit(":", 1)
-        s = socket.create_connection((host, int(port)), timeout=self.timeout_s)
+        try:
+            s = socket.create_connection(
+                (host, int(port)), timeout=self.connect_timeout_s
+            )
+        except OSError as e:
+            self._redial_failures += 1
+            base = min(
+                self._REDIAL_BASE_S * 2 ** (self._redial_failures - 1),
+                self._REDIAL_MAX_S,
+            )
+            self._redial_not_before = now + base * random.uniform(0.5, 1.0)
+            raise ConnectionError(f"sidecar dial {self.addr}: {e}") from e
+        self._redial_failures = 0
+        # Blocking mode from here: request deadlines are enforced by the
+        # waiter's Event (timeout_s), and a lingering socket timeout would
+        # make the reader thread kill an idle-but-healthy connection.
+        s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
         threading.Thread(

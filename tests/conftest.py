@@ -33,3 +33,8 @@ def pytest_configure(config):
         "slow: long multi-process e2e runs, excluded from the tier-1 "
         "`-m 'not slow'` sweep",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (seeded CMTPU_FAULTS, "
+        "CPU-only) for the verification-backend supervisor; runs in tier-1",
+    )

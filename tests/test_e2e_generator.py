@@ -60,7 +60,7 @@ def test_full_profile_reaches_every_dimension():
     assert any(n["abci"] == "grpc" for n in nodes)
     for kt in ("ed25519", "secp256k1", "sr25519", "bn254"):
         assert any(n["key_type"] == kt for n in nodes), kt
-    for p in ("kill", "pause", "disconnect", "restart"):
+    for p in ("kill", "pause", "disconnect", "restart", "backend_faults"):
         assert any(p in n["perturb"] for n in nodes), p
 
 
@@ -175,8 +175,14 @@ def _seeds_with(profile, want, n=500):
 def test_matrix_smoke(tmp_path):
     """Three small seeds end-to-end through the real runner: every run must
     reach its target and agree on one block hash (the matrix acceptance
-    bar).  Prefers seeds that exercise a late join and an external ABCI
+    bar).  Prefers seeds that exercise a backend_faults perturbation (the
+    chaos-injected supervised chain), a late join, and an external ABCI
     boundary so the smoke covers more than the trivial corner."""
+    faulted = _seeds_with(
+        "small",
+        lambda s: any("backend_faults" in n["perturb"] for n in s["nodes"]),
+    )
+    assert faulted, "small profile must be able to sample backend_faults"
     late = _seeds_with(
         "small", lambda s: any(n["start_at"] > 0 for n in s["nodes"])
     )
@@ -184,12 +190,15 @@ def test_matrix_smoke(tmp_path):
         "small", lambda s: any(n["abci"] != "local" for n in s["nodes"])
     )
     seeds = []
-    for pool in (late, ext, range(500)):
+    for pool in (faulted, late, ext, range(500)):
+        if len(seeds) == 3:
+            break
         for s in pool:
             if s not in seeds:
                 seeds.append(s)
                 break
     assert len(seeds) == 3
+    assert seeds[0] in faulted, "matrix must include a backend_faults seed"
     summary = run_matrix(
         seeds, str(tmp_path), profile="small", log=lambda s: None
     )

@@ -3,6 +3,8 @@
 """
 
 import socket
+import threading
+import time
 
 import pytest
 
@@ -174,3 +176,130 @@ def test_pipelined_concurrent_requests(sidecar):
     assert len(results) == 16 and all(results)
     # the connection survives and serves a subsequent call
     assert client.ping()
+
+
+class _WedgedServer:
+    """Accepts connections, reads forever, never replies — the failure mode
+    where the sidecar process is alive but its worker is stuck on-device."""
+
+    def __init__(self):
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(4)
+        self.addr = "127.0.0.1:%d" % self._lsock.getsockname()[1]
+        self._conns = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._lsock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._conns.append(conn)  # hold it open; never write back
+
+    def shutdown(self):
+        self._stop.set()
+        self._lsock.close()
+        for c in self._conns:
+            c.close()
+        self._thread.join(timeout=2)
+
+
+def test_wedged_server_times_out_within_deadline():
+    """Satellite: the server accepts but never replies. The client must
+    surface TimeoutError within the configured deadline — not hang."""
+    server = _WedgedServer()
+    client = GrpcBackend(server.addr, timeout_s=0.3)
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError, match="timed out"):
+            client.ping()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"wedged ping took {elapsed:.1f}s"
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_wedged_server_degrades_through_supervisor():
+    """The full ISSUE shape: supervised chain over a wedged sidecar still
+    answers correctly in bounded time, and the second call fails over
+    without paying the deadline again (the breaker trips)."""
+    from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+    server = _WedgedServer()
+    client = GrpcBackend(server.addr, timeout_s=30)  # client knob loose:
+    # the SUPERVISOR deadline is what bounds the call.
+    sup = ResilientBackend(
+        [("grpc", client), ("cpu", CpuBackend())],
+        deadline_ms=300, retries=0, backoff_ms=1,
+        breaker_threshold=2, breaker_cooldown_ms=60_000, crosscheck="off",
+    )
+    try:
+        pv = ed25519.gen_priv_key_from_secret(b"wedged-sidecar")
+        pub, msg = pv.pub_key().bytes(), b"still-answered"
+        sig = pv.sign(msg)
+        t0 = time.perf_counter()
+        ok, bits = sup.batch_verify([pub] * 4, [msg] * 4, [sig] * 4)
+        first_ms = (time.perf_counter() - t0) * 1000
+        assert ok and bits == [True] * 4
+        assert first_ms < 2 * 300, f"degradation took {first_ms:.0f} ms"
+        t0 = time.perf_counter()
+        ok, _ = sup.batch_verify([pub] * 4, [msg] * 4, [sig] * 4)
+        second_ms = (time.perf_counter() - t0) * 1000
+        assert ok and second_ms < 300
+        c = sup.counters()
+        assert c["deadline_exceeded"] >= 1 and c["active_tier"] == "cpu"
+    finally:
+        sup.close()
+        server.shutdown()
+
+
+def test_redial_backoff_fails_fast_in_window():
+    """Satellite: after a dial failure the client does not re-dial on every
+    call — inside the backoff window it fails fast with ConnectionError."""
+    port = _free_port()  # nothing listening
+    client = GrpcBackend(f"127.0.0.1:{port}", timeout_s=1, connect_timeout_s=0.2)
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            client.ping()
+        assert client._redial_failures >= 1
+        # Within the window: instant ConnectionError, no 0.2 s dial attempt.
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError, match="redial backoff"):
+            client.ping()
+        assert time.perf_counter() - t0 < 0.1
+    finally:
+        client.close()
+
+
+def test_redial_succeeds_after_window_when_server_returns():
+    """The other half of the satellite: once the backoff window passes and
+    the sidecar is back, the next call redials and succeeds."""
+    port = _free_port()
+    client = GrpcBackend(f"127.0.0.1:{port}", timeout_s=5, connect_timeout_s=0.2)
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            client.ping()
+        server = SidecarServer(f"127.0.0.1:{port}", backend=CpuBackend()).start()
+        try:
+            deadline = time.monotonic() + 5
+            while True:
+                try:
+                    assert client.ping()
+                    break
+                except ConnectionError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+            assert client._redial_failures == 0  # reset on success
+        finally:
+            server.shutdown()
+    finally:
+        client.close()
