@@ -553,12 +553,19 @@ def tpu_worker() -> None:
     # program stays as a diagnostic of the on-device path.
     if budget_left():
         try:
+            from cometbft_tpu.crypto.merkle import proof as _proof_mod
             from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
 
             stages["merkle_proofs_ms"] = round(
                 best_of(lambda: proofs_from_byte_slices(txs), reps=2), 1
             )
-            plog(f"proofs (shipped path): {stages['merkle_proofs_ms']} ms")
+            # Host-side by default even on device runs (CMTPU_DEVICE_PROOFS=1
+            # opts back into the device path, which measured ~12x slower).
+            stages["merkle_proofs_path"] = _proof_mod.last_proofs_path
+            plog(
+                f"proofs (shipped path): {stages['merkle_proofs_ms']} ms "
+                f"[{stages['merkle_proofs_path']}]"
+            )
         except Exception as e:
             plog(f"proofs stage failed: {type(e).__name__}: {e}")
     if budget_left():
@@ -643,6 +650,123 @@ def _resilience_stage(stages: dict, plog) -> None:
     sup.close()
 
 
+def _coalesce_stage(stages: dict, plog) -> None:
+    """Scheduler micro-batching (ISSUE 3): K concurrent SIGS-sig commit
+    verifications through the coalescing scheduler vs serialized per-caller
+    dispatch.  Both arms run the same commits through the same host-MSM
+    backend wrapped with a fixed per-dispatch latency
+    (CMTPU_BENCH_DISPATCH_MS, default 50 — the LOW end of the measured
+    50-150 ms axon-tunnel fixed cost per device dispatch,
+    cometbft_tpu/ops/DESIGN.md), so the number reports what coalescing
+    saves when every dispatch pays the device round trip: the serialized
+    arm pays it K times, the coalesced arm once or twice.  The simulated
+    cost is labeled in the JSON (`simulated_dispatch_ms`; set it to 0 to
+    measure raw host MSM coalescing alone)."""
+    import threading as _threading
+
+    from cometbft_tpu.crypto import ed25519 as _ed
+    from cometbft_tpu.sidecar import backend as _be
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+    from cometbft_tpu.types import validation
+
+    k = int(os.environ.get("CMTPU_BENCH_COALESCE_K", "8"))
+    sigs = int(os.environ.get("CMTPU_BENCH_COALESCE_SIGS", "1024"))
+    dispatch_ms = float(os.environ.get("CMTPU_BENCH_DISPATCH_MS", "50"))
+
+    vals, commits = _commit_fixture(sigs, heights=k, tag=b"co")
+    plog(f"coalesce fixture built ({k} x {sigs})")
+    for _, commit in commits:
+        commit.vote_sign_bytes_all("bench-chain")  # warm encodes, both arms
+
+    class _DispatchLatency:
+        """CpuBackend plus the fixed per-dispatch cost a device pays."""
+
+        name = "latency"
+
+        def __init__(self):
+            self._cpu = CpuBackend()
+            self.calls = 0
+
+        def batch_verify(self, pubs, msgs, sigs_):
+            self.calls += 1
+            if dispatch_ms > 0:
+                time.sleep(dispatch_ms / 1000.0)
+            return self._cpu.batch_verify(pubs, msgs, sigs_)
+
+        def merkle_root(self, leaves):
+            return self._cpu.merkle_root(leaves)
+
+    def _run_commit(i):
+        bid, commit = commits[i]
+        validation.verify_commit_light("bench-chain", vals, bid, i + 1, commit)
+
+    old_backend = _be._backend
+    try:
+        # -- serialized per-caller dispatch (the pre-scheduler world) --
+        lat = _DispatchLatency()
+        _be.set_backend(lat)
+        _ed._verified.clear()
+        t0 = time.perf_counter()
+        for i in range(k):
+            _run_commit(i)
+        serialized_ms = (time.perf_counter() - t0) * 1000
+        assert lat.calls == k
+
+        # -- coalesced: K concurrent callers through the scheduler --
+        lat2 = _DispatchLatency()
+        sched = CoalescingScheduler(lat2, window_ms=5.0)
+        _be.set_backend(sched)
+        _ed._verified.clear()
+        start = _threading.Barrier(k + 1)
+        errors = []
+
+        def _caller(i):
+            start.wait()
+            try:
+                _run_commit(i)
+            except Exception as e:  # pragma: no cover - stage must report
+                errors.append(e)
+
+        threads = [
+            _threading.Thread(target=_caller, args=(i,)) for i in range(k)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(300.0)
+        coalesced_ms = (time.perf_counter() - t0) * 1000
+        if errors:
+            raise errors[0]
+        c = sched.counters()
+        sched.close()
+        stages["coalesce"] = {
+            "k": k,
+            "sigs_per_request": sigs,
+            "simulated_dispatch_ms": dispatch_ms,
+            "serialized_ms": round(serialized_ms, 2),
+            "coalesced_ms": round(coalesced_ms, 2),
+            "speedup": round(serialized_ms / max(coalesced_ms, 1e-9), 2),
+            "serialized_dispatches": lat.calls,
+            "coalesced_dispatches": lat2.calls,
+            "coalesce_ratio": c["coalesce_ratio"],
+            "queue_wait_p50_ms": c["queue_wait_p50_ms"],
+            "queue_wait_p95_ms": c["queue_wait_p95_ms"],
+            "fallback_splits": c["fallback_splits"],
+        }
+        plog(
+            f"coalesce: {k}x{sigs} serialized {serialized_ms:.0f} ms "
+            f"-> coalesced {coalesced_ms:.0f} ms "
+            f"({stages['coalesce']['speedup']}x, "
+            f"{lat2.calls} dispatches, ratio {c['coalesce_ratio']})"
+        )
+    finally:
+        _ed._verified.clear()
+        _be.set_backend(old_backend)
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -706,15 +830,29 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             f"({stages['blocksync_replay_ms_per_block']} ms/block)"
         )
 
+    # ---- scheduler micro-batching: coalesced vs serialized dispatch ----
+    if budget_left():
+        try:
+            _coalesce_stage(stages, plog)
+        except Exception as e:
+            plog(f"coalesce stage failed: {type(e).__name__}: {e}")
+
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":
+        from cometbft_tpu.crypto.merkle import proof as _proof_mod
         from cometbft_tpu.crypto.merkle import proofs_from_byte_slices
 
         txs = [b"bench-tx-%08d" % i for i in range(N_LEAVES)]
         stages["merkle_proofs_ms"] = round(
             best_of(lambda: proofs_from_byte_slices(txs), reps=2), 1
         )
-        plog(f"proofs (host) @{N_LEAVES}: {stages['merkle_proofs_ms']} ms")
+        # Which implementation served the shipped call (host by default;
+        # device only under CMTPU_DEVICE_PROOFS=1).
+        stages["merkle_proofs_path"] = _proof_mod.last_proofs_path
+        plog(
+            f"proofs (host) @{N_LEAVES}: {stages['merkle_proofs_ms']} ms "
+            f"[{stages['merkle_proofs_path']}]"
+        )
 
     # ---- system level: 4-validator devnet over real TCP, tx throughput ----
     if budget_left():

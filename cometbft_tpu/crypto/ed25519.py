@@ -16,6 +16,7 @@ TPU batch verifier instead (cometbft_tpu/ops/ed25519_kernel.py).
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 
 from cometbft_tpu import crypto
@@ -146,8 +147,12 @@ def _from_seed(seed: bytes) -> PrivKey:
 # gets the same answer crypto would give), keyed by the (pub, sig, msg)
 # TUPLE — bytes objects hash once and cache it, so tuple keys skip the
 # per-lookup concatenation a bytes key would pay (~8 MB of copies per
-# 10k-commit cached verify). Bounded: oldest quarter evicted on overflow.
-_VERIFIED_MAX = 131072
+# 10k-commit cached verify). Bounded (`CMTPU_VERIFY_CACHE_MAX`, mirroring
+# the _CACHE_SIZE pubkey-cache pattern): oldest quarter evicted on
+# overflow, re-verified triples refreshed to the young end, so a
+# long-running node under heavy traffic holds its working set instead of
+# growing without limit.
+_VERIFIED_MAX = int(os.environ.get("CMTPU_VERIFY_CACHE_MAX", "") or 131072)
 _verified: dict[tuple, None] = {}
 _verified_lock = threading.Lock()
 
@@ -163,8 +168,13 @@ def _verified_put_many(keys: list[tuple]) -> None:
         return
     with _verified_lock:
         for key in keys:
-            if len(_verified) >= _VERIFIED_MAX:
-                for k in list(_verified)[: _VERIFIED_MAX // 4]:
+            if key in _verified:
+                # LRU refresh: a re-verified triple moves to the young end
+                # (dict order is insertion order), so hot validators survive
+                # eviction sweeps.
+                del _verified[key]
+            elif len(_verified) >= _VERIFIED_MAX:
+                for k in list(_verified)[: max(1, _VERIFIED_MAX // 4)]:
                     _verified.pop(k, None)
             _verified[key] = None
 
@@ -210,19 +220,46 @@ class BatchVerifier(crypto.BatchVerifier):
 
         if not self._pubs:
             return False, []
+        # Dispatch only the triples the cache cannot answer, deduplicating
+        # repeats within the batch (the light client's trusting and light
+        # checks of one hop share most of their triples; bisection descents
+        # revisit pivot commits). lane_of records each unique uncached
+        # triple's lane in the sub-batch; cached/duplicate entries resolve
+        # from it after the dispatch. Membership is decided ONCE here —
+        # concurrent writers may grow the cache mid-verify, and the merge
+        # below must honor the filter's snapshot, not a fresher one.
         keys = list(zip(self._pubs, self._sigs, self._msgs))
-        if all(k in _verified for k in keys):
+        lane_of: dict[tuple, int] = {}
+        lanes: list[int] = []  # per-entry lane, -1 = cache hit
+        sub_pubs: list[bytes] = []
+        sub_msgs: list[bytes] = []
+        sub_sigs: list[bytes] = []
+        for key in keys:
+            if key in _verified:
+                lanes.append(-1)
+                continue
+            lane = lane_of.get(key)
+            if lane is None:
+                lane = len(sub_pubs)
+                lane_of[key] = lane
+                sub_pubs.append(key[0])
+                sub_msgs.append(key[2])
+                sub_sigs.append(key[1])
+            lanes.append(lane)
+        if not sub_pubs:
             return True, [True] * len(keys)
         try:
-            ok, bits = get_backend().batch_verify(self._pubs, self._msgs, self._sigs)
+            _, sub_bits = get_backend().batch_verify(sub_pubs, sub_msgs, sub_sigs)
         except ChainExhausted:
             # Every tier of the supervised chain failed (chaos runs can
             # arrange this). Consensus liveness outranks batch speed:
             # verify each signature through the scalar ZIP-215 path.
-            bits = [
+            sub_bits = [
                 ed25519_pure.verify_zip215(p, m, s)
-                for p, m, s in zip(self._pubs, self._msgs, self._sigs)
+                for p, m, s in zip(sub_pubs, sub_msgs, sub_sigs)
             ]
-            ok = all(bits)
-        _verified_put_many([k for k, valid in zip(keys, bits) if valid])
-        return ok, bits
+        bits = [True if lane < 0 else sub_bits[lane] for lane in lanes]
+        _verified_put_many(
+            [k for k, lane in zip(keys, lanes) if lane >= 0 and sub_bits[lane]]
+        )
+        return all(bits), bits

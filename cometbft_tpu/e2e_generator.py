@@ -47,7 +47,10 @@ _KEY_TYPES_FULL = (
 )
 _ABCI_FULL = ("local",) * 5 + ("socket",) * 3 + ("grpc",) * 2
 _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
-_PERTURB_FULL = ("kill", "pause", "disconnect", "restart", "backend_faults")
+_PERTURB_FULL = (
+    "kill", "pause", "disconnect", "restart", "backend_faults",
+    "concurrent_light_clients",
+)
 _PERTURB_SMALL = ("pause", "restart", "backend_faults")
 
 

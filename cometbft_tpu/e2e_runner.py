@@ -59,7 +59,10 @@ from cometbft_tpu.libs import tomlcompat as tomllib
 
 MODES = ("validator", "full", "seed")
 ABCI_MODES = ("local", "socket", "grpc")
-PERTURBATIONS = ("kill", "pause", "disconnect", "restart", "backend_faults")
+PERTURBATIONS = (
+    "kill", "pause", "disconnect", "restart", "backend_faults",
+    "concurrent_light_clients",
+)
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
 
@@ -191,6 +194,9 @@ class E2ERunner:
         # Nodes whose verification backend runs fault-injected (the
         # backend_faults perturbation arms this before relaunch).
         self._fault_armed: set[str] = set()
+        # Per-node results of the concurrent_light_clients perturbation
+        # (swarm agreement + the runner-process coalesce counter deltas).
+        self._light_swarms: dict[str, dict] = {}
 
     # -- setup ------------------------------------------------------------
 
@@ -438,6 +444,13 @@ class E2ERunner:
             proc.send_signal(signal.SIGSTOP)
             time.sleep(3.0)
             proc.send_signal(signal.SIGCONT)
+        elif kind == "concurrent_light_clients":
+            # No process disruption: the stress IS the perturbation.  N
+            # light clients bisect against this node simultaneously; their
+            # commit verifications land in the runner-process coalescing
+            # scheduler, which must merge them into shared dispatches while
+            # every swarm member still converges on the same hash.
+            self._light_swarms[name] = self._light_client_swarm(node)
         elif kind == "disconnect":
             pid = proc.pid
             t_end = time.time() + 4.0
@@ -564,6 +577,86 @@ class E2ERunner:
         lb = client.verify_light_block_at_height(height, cmttime.now())
         return {"height": lb.height, "hash": lb.hash().hex().upper()}
 
+    def _coalesce_counters(self) -> dict | None:
+        """Runner-process scheduler counter snapshot (integer counts only).
+
+        None when verification isn't routed through the coalescing
+        scheduler — backend not yet built, or CMTPU_COALESCE=0."""
+        from cometbft_tpu.sidecar import backend as backend_mod
+
+        b = backend_mod._backend
+        if b is None or getattr(b, "name", "") != "coalesce":
+            return None
+        return {k: v for k, v in b.counters().items() if isinstance(v, int)}
+
+    def _light_client_swarm(self, node: ManifestNode, n_clients: int = 4) -> dict:
+        """N skipping-mode light clients bisect against `node` at once.
+
+        The swarm's commit verifications all land in this (runner)
+        process's verification backend, so concurrent bisections should
+        coalesce into shared dispatches.  Every member must converge on
+        the same hash; the returned dict carries the swarm result plus the
+        scheduler counter deltas attributable to the swarm."""
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.light.store import LightStore
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types import cmttime
+
+        name = node.name
+        url = f"http://127.0.0.1:{self.rpc_ports[name]}"
+        target = max(2, self._height(name))
+        blk = HTTPClient(url, timeout=5).block(1)
+        trust = TrustOptions(
+            period_ns=int(3600 * 10**9),
+            height=1,
+            hash=bytes.fromhex(blk["block_id"]["hash"]),
+        )
+        before = self._coalesce_counters() or {}
+        results: list = [None] * n_clients
+        barrier = threading.Barrier(n_clients)
+
+        def bisect(i: int) -> None:
+            try:
+                barrier.wait(timeout=30)
+                client = Client(
+                    "e2e-manifest", trust,
+                    HTTPProvider("e2e-manifest", HTTPClient(url, timeout=5)),
+                    [], LightStore(MemDB()),
+                )
+                lb = client.verify_light_block_at_height(target, cmttime.now())
+                results[i] = ("ok", lb.hash().hex().upper())
+            except Exception as exc:  # surfaced by the agreement check
+                results[i] = ("error", repr(exc))
+
+        threads = [
+            threading.Thread(target=bisect, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        bad = [r for r in results if r is None or r[0] != "ok"]
+        if bad:
+            raise AssertionError(f"{name}: light swarm failures: {bad}")
+        hashes = {r[1] for r in results}
+        if len(hashes) != 1:
+            raise AssertionError(
+                f"{name}: light swarm hash disagreement: {hashes}"
+            )
+        out = {"clients": n_clients, "height": target, "hash": hashes.pop()}
+        after = self._coalesce_counters()
+        if after is not None:
+            delta = {k: v - before.get(k, 0) for k, v in after.items()}
+            disp = delta.get("dispatches", 0)
+            delta["coalesce_ratio"] = (
+                round(delta.get("requests", 0) / disp, 3) if disp else 0.0
+            )
+            out["coalesce"] = delta
+        return out
+
     # -- the run ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -634,6 +727,8 @@ class E2ERunner:
             }
             if self._fault_armed:
                 report["backend_faults"] = sorted(self._fault_armed)
+            if self._light_swarms:
+                report["concurrent_light_clients"] = self._light_swarms
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

@@ -81,6 +81,8 @@ class Client:
         self.pruning_size = pruning_size
         self.mode = skip_verification
         self.logger = logger
+        # Speculative-bisection counters (bench/e2e observability).
+        self.speculation = {"descents": 0, "prewarmed_sigs": 0}
         self._init_trust(trust_options)
 
     # -- initialization (client.go:266-360) -----------------------------------
@@ -193,7 +195,15 @@ class Client:
         return trace
 
     def _verify_skipping(self, trusted: LightBlock, target: LightBlock, now: Time):
-        """client.go:706 verifySkipping: bisection on ErrNewValSetCantBeTrusted."""
+        """client.go:706 verifySkipping: bisection on ErrNewValSetCantBeTrusted.
+
+        With speculative bisection: after each pivot fetch, the commits the
+        descent will verify if the optimistic path holds (pivot, then every
+        block still on the stack) are batch-prewarmed through the backend in
+        one dispatch (`_speculate_descent`), so the sequential hop checks
+        below run as verified-triple cache hits.  The decision logic is
+        untouched — speculation only ever inserts VALID triples into the
+        cache, so the trace is bit-identical to the unspeculated walk."""
         trace = []
         current = trusted
         stack = [target]
@@ -221,11 +231,53 @@ class Client:
                 lb = self.primary.light_block(pivot)
                 lb.validate_basic(self.chain_id)
                 stack.append(lb)
+                self._speculate_descent(current, stack)
                 continue
             current = candidate
             stack.pop()
             trace.append(candidate)
         return trace
+
+    def _speculate_descent(self, current: LightBlock, stack: list) -> None:
+        """Prewarm the verified-triple cache for the descent's optimistic
+        hop chain: (current -> stack[-1]), (stack[-1] -> stack[-2]), ...,
+        (stack[1] -> stack[0]).  One BatchVerifier call carries every hop's
+        union prefix — when the process backend is the coalescing scheduler
+        this also merges with other clients' concurrent descents.  Errors
+        are swallowed: speculation is an accelerator, never an arbiter (the
+        sequential checks in _verify_skipping re-derive every verdict)."""
+        try:
+            from cometbft_tpu.crypto import ed25519
+            from cometbft_tpu.types import validation
+
+            triples: list[tuple] = []
+            lower = current
+            for upper in reversed(stack):
+                adjacent = upper.height == lower.height + 1
+                triples.extend(
+                    validation.speculative_verify_triples(
+                        self.chain_id,
+                        lower.validator_set,
+                        upper.validator_set,
+                        upper.signed_header.commit,
+                        None if adjacent else self.trust_level,
+                    )
+                )
+                lower = upper
+            if not triples:
+                return
+            bv = ed25519.BatchVerifier()
+            for pub, msg, sig in triples:
+                try:
+                    bv.add(pub, msg, sig)
+                except (TypeError, ValueError):
+                    continue  # non-ed25519 or malformed entry: engine's call
+            if len(bv):
+                self.speculation["descents"] += 1
+                self.speculation["prewarmed_sigs"] += len(bv)
+                bv.verify()  # cache-filters, dedups, populates _verified
+        except Exception:
+            pass
 
     def _verify_backwards(self, target: LightBlock) -> None:
         """client.go backwards: hash-chain from the earliest trusted header."""

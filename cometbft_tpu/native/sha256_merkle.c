@@ -130,9 +130,20 @@ static void sha256_block_ni(u32 st[8], const u8 *p) {
 
 static int g_has_sha_ni = -1;
 
+#ifdef CMTPU_X86
+#include <cpuid.h>
+/* CPUID leaf 7 EBX bit 29 = SHA extensions.  Probed directly because
+ * __builtin_cpu_supports("sha") only exists from gcc 11. */
+static int detect_sha_ni(void) {
+    unsigned int a, b, c, d;
+    if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return 0;
+    return (b >> 29) & 1;
+}
+#endif
+
 static void sha256_block(u32 st[8], const u8 *p) {
 #ifdef CMTPU_X86
-    if (g_has_sha_ni < 0) g_has_sha_ni = __builtin_cpu_supports("sha");
+    if (g_has_sha_ni < 0) g_has_sha_ni = detect_sha_ni();
     if (g_has_sha_ni) { sha256_block_ni(st, p); return; }
 #endif
     sha256_block_soft(st, p);

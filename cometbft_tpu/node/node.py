@@ -289,8 +289,8 @@ class Node:
     @staticmethod
     def _register_backend_metrics(reg) -> None:
         """backend_trips / backend_retries / backend_deadline_exceeded /
-        backend_active_tier gauges, sampled lazily off the process-wide
-        verification backend.  Sampling (not registering) checks for the
+        backend_active_tier gauges plus the scheduler_* coalescer gauges,
+        sampled lazily off the process-wide verification backend.  Sampling (not registering) checks for the
         supervisor so scraping never forces backend construction — under
         CMTPU_BACKEND=auto with an accelerator visible that would import
         jax at node boot instead of first verification."""
@@ -299,12 +299,30 @@ class Node:
         def sample(key):
             def fn():
                 b = backend_mod._backend  # no get_backend(): never constructs
+                if getattr(b, "name", "") == "coalesce":
+                    b = b.inner  # supervisor gauges read the wrapped chain
                 counters = getattr(b, "counters", None)
                 if counters is None:
                     return 0
                 c = counters()
                 if key == "active_tier":
                     return b.active_tier_index
+                return c.get(key, 0)
+
+            return fn
+
+        def sched_sample(key):
+            # Lazy like sample(): zeros until the coalescing scheduler
+            # exists (CMTPU_COALESCE=0 keeps them zero forever).
+            def fn():
+                b = backend_mod._backend
+                if getattr(b, "name", "") != "coalesce":
+                    return 0
+                c = b.counters()
+                if key == "coalesce_ratio_milli":
+                    return int(1000 * c["requests"] / max(1, c["dispatches"]))
+                if key == "queue_wait_p95_us":
+                    return int(c["queue_wait_p95_ms"] * 1000)
                 return c.get(key, 0)
 
             return fn
@@ -322,6 +340,24 @@ class Node:
                        "Degradation-chain index of the serving tier "
                        "(0 = primary).",
                        sample("active_tier"))
+        reg.gauge_func("scheduler", "requests",
+                       "Verification requests submitted to the coalescer.",
+                       sched_sample("requests"))
+        reg.gauge_func("scheduler", "dispatches",
+                       "Backend dispatches the coalescer issued.",
+                       sched_sample("dispatches"))
+        reg.gauge_func("scheduler", "batched_requests",
+                       "Requests that shared a coalesced dispatch.",
+                       sched_sample("batched_requests"))
+        reg.gauge_func("scheduler", "fallback_splits",
+                       "Coalesced dispatches split into per-request retries.",
+                       sched_sample("fallback_splits"))
+        reg.gauge_func("scheduler", "coalesce_ratio_milli",
+                       "Requests per dispatch x1000.",
+                       sched_sample("coalesce_ratio_milli"))
+        reg.gauge_func("scheduler", "queue_wait_p95_us",
+                       "95th-percentile coalescer queue wait, microseconds.",
+                       sched_sample("queue_wait_p95_us"))
 
     # -- lifecycle ------------------------------------------------------------
 

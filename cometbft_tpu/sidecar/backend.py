@@ -431,7 +431,16 @@ def _make_backend() -> VerifyBackend:
         # means "fail loudly", not "silently verify somewhere else".
         from cometbft_tpu.sidecar.supervisor import build_resilient
 
-        return build_resilient()
+        chain = build_resilient()
+        if os.environ.get("CMTPU_COALESCE", "1") != "0":
+            # Outermost tier: coalesce concurrent callers' requests into
+            # single dispatches (sidecar/scheduler.py). CMTPU_COALESCE=0
+            # strips the layer for A/B and for callers that need the bare
+            # supervised chain.
+            from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+
+            return CoalescingScheduler(chain)
+        return chain
     return device_backend(choice)
 
 

@@ -324,6 +324,7 @@ class Commit:
     signatures: list = dfield(default_factory=list)
     _hash: bytes | None = dfield(default=None, compare=False, repr=False)
     _sb_cache: tuple | None = dfield(default=None, compare=False, repr=False)
+    _sba_cache: tuple | None = dfield(default=None, compare=False, repr=False)
 
     def size(self) -> int:
         return len(self.signatures)
@@ -377,10 +378,21 @@ class Commit:
         feeder. Vectorized over the commit with numpy: per-signature work is
         two varints spliced into a shared template, so the whole 10k-row
         build is a handful of array passes grouped by byte layout
-        (flag x varint widths). Byte-identical to vote_sign_bytes(i)."""
+        (flag x varint widths). Byte-identical to vote_sign_bytes(i).
+
+        Memoized per (chain_id, commit): the light client's trusting and
+        light checks of one hop, plus a bisection descent revisiting pivot
+        commits, would otherwise rebuild the same 4k-row list several times
+        per descent. Commits are immutable after construction (the same
+        contract _hash and _sb_cache rely on)."""
+        cached = self._sba_cache
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
         n = len(self.signatures)
         if n < 64:
-            return [self.vote_sign_bytes(chain_id, i) for i in range(n)]
+            out = [self.vote_sign_bytes(chain_id, i) for i in range(n)]
+            self._sba_cache = (chain_id, out)
+            return out
         import numpy as np
 
         _, pre_commit, pre_nil, suffix = self._sign_bytes_cache(chain_id)
@@ -452,6 +464,7 @@ class Commit:
             buf = m.tobytes()
             for j, i in enumerate(rows):
                 out[i] = buf[j * total : (j + 1) * total]
+        self._sba_cache = (chain_id, out)
         return out
 
     @staticmethod

@@ -7,6 +7,7 @@ everything needed to verify the commit and chain to the next header.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from dataclasses import field as dfield
 
 from cometbft_tpu.types.block import SignedHeader
 from cometbft_tpu.types.validator_set import ValidatorSet
@@ -19,6 +20,10 @@ class LightBlock:
 
     signed_header: SignedHeader
     validator_set: ValidatorSet
+    # Encode memo (immutable-after-construction, the Commit._hash contract):
+    # providers hand the same LightBlock to store saves and gossip encodes
+    # repeatedly, and a 4k-validator block costs ~100 ms per encode.
+    _enc: bytes | None = dfield(default=None, compare=False, repr=False)
 
     @property
     def height(self) -> int:
@@ -47,13 +52,19 @@ class LightBlock:
             )
 
     def encode(self) -> bytes:
-        return wire.field_message(
-            1, self.signed_header.encode(), emit_empty=True
-        ) + wire.field_message(2, self.validator_set.encode(), emit_empty=True)
+        if self._enc is None:
+            self._enc = wire.field_message(
+                1, self.signed_header.encode(), emit_empty=True
+            ) + wire.field_message(
+                2, self.validator_set.encode(), emit_empty=True
+            )
+        return self._enc
 
     @classmethod
     def decode(cls, data: bytes) -> "LightBlock":
         f = wire.decode_fields(data)
+        # No encode-memo from the wire input: a peer's non-canonical field
+        # order must not survive as this block's canonical encoding.
         return cls(
             signed_header=SignedHeader.decode(wire.get_bytes(f, 1)),
             validator_set=ValidatorSet.decode(wire.get_bytes(f, 2)),

@@ -220,6 +220,83 @@ def _verify_commit_single(
         raise ErrNotEnoughVotingPowerSigned(tallied, voting_power_needed)
 
 
+def speculative_verify_triples(
+    chain_id: str,
+    trusted_vals,
+    untrusted_vals,
+    commit: Commit,
+    trust_level: Fraction | None,
+) -> list[tuple]:
+    """(pub_key, sign_bytes, signature) triples a hop's commit checks WILL
+    verify — the speculative-bisection feeder (light/client.py).
+
+    A non-adjacent hop runs verify_commit_light_trusting (old set, by
+    address) then verify_commit_light (new set, by index); both walk the
+    commit's signatures in order and stop at their quorum, and a
+    signature's verify triple is identical in both (sign bytes depend only
+    on the commit and chain id, never on the verifying set). This returns
+    the union prefix both engines would touch, so prewarming the
+    verified-triple cache with it makes the sequential checks pure cache
+    hits without changing what they decide. trust_level=None means an
+    adjacent hop: only the light-check prefix applies.
+
+    Speculation must never fail a client, so malformed input returns []
+    and unresolvable entries are skipped rather than raised on.
+    """
+    from cometbft_tpu.types.validator_set import safe_mul
+
+    if commit is None or untrusted_vals is None:
+        return []
+    if untrusted_vals.size() != len(commit.signatures):
+        return []  # light check will reject this hop; nothing to prewarm
+    light_needed = untrusted_vals.total_voting_power() * 2 // 3
+    trusting_needed = -1  # adjacent: trivially satisfied
+    if trust_level is not None and trusted_vals is not None:
+        total_mul, overflow = safe_mul(
+            trusted_vals.total_voting_power(), trust_level.numerator
+        )
+        if overflow:
+            return []
+        trusting_needed = total_mul // trust_level.denominator
+    all_sign_bytes = commit.vote_sign_bytes_all(chain_id)
+    triples: list[tuple] = []
+    light_tally = 0
+    trusting_tally = 0
+    seen: set[int] = set()
+    for idx, commit_sig in enumerate(commit.signatures):
+        if not commit_sig.for_block_flag():
+            continue  # both engines ignore non-BlockIDFlagCommit entries
+        light_live = light_tally <= light_needed
+        trusting_live = trusting_tally <= trusting_needed
+        if not light_live and not trusting_live:
+            break
+        val = untrusted_vals.validators[idx]
+        if light_live:
+            light_tally += val.voting_power
+            triples.append(
+                (val.pub_key, all_sign_bytes[idx], commit_sig.signature)
+            )
+        if trusting_live:
+            t_idx, t_val = trusted_vals.get_by_address(
+                commit_sig.validator_address
+            )
+            if t_val is not None and t_idx not in seen:
+                seen.add(t_idx)
+                trusting_tally += t_val.voting_power
+                # The trusting engine keys its triple by the TRUSTED set's
+                # pubkey (address lookup); normally identical to the new
+                # set's, so the light triple above already covers it.
+                if not light_live or t_val.pub_key.bytes() != val.pub_key.bytes():
+                    triples.append(
+                        (
+                            t_val.pub_key,
+                            all_sign_bytes[idx],
+                            commit_sig.signature,
+                        )
+                    )
+    return triples
+
+
 def _verify_basic_vals_and_commit(vals, commit, height: int, block_id: BlockID) -> None:
     """types/validation.go:342-365."""
     if vals is None:

@@ -59,6 +59,10 @@ class ValidatorSet:
         # signature's validator up by address, which is O(n^2) per commit as
         # a linear scan at 4k+ validators.  Invalidated on membership change.
         self._addr_index: dict[bytes, int] | None = None
+        # Merkle-root memo: the hash covers (pubkey, power) per validator in
+        # order, so it shares _addr_index's invalidation points (membership/
+        # power changes); proposer-priority rotation leaves it intact.
+        self._hash_memo: bytes | None = None
         if validators:
             err = self._update_with_change_set(
                 [v.copy() for v in validators], allow_deletes=False
@@ -136,7 +140,11 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root over SimpleValidator leaves (validator_set.go:347)."""
-        return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+        if self._hash_memo is None:
+            self._hash_memo = merkle.hash_from_byte_slices(
+                [v.bytes() for v in self.validators]
+            )
+        return self._hash_memo
 
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():
@@ -287,6 +295,7 @@ class ValidatorSet:
         self._shift_by_avg_proposer_priority()
         self.validators.sort(key=_by_voting_power_key)
         self._addr_index = None
+        self._hash_memo = None
         return None
 
     def _apply_updates(self, updates: list[Validator]) -> None:
@@ -306,6 +315,7 @@ class ValidatorSet:
         merged.extend(updates[j:])
         self.validators = merged
         self._addr_index = None
+        self._hash_memo = None
 
     def _apply_removals(self, deletes: list[Validator]) -> None:
         if not deletes:
@@ -313,6 +323,7 @@ class ValidatorSet:
         dset = {d.address for d in deletes}
         self.validators = [v for v in self.validators if v.address not in dset]
         self._addr_index = None
+        self._hash_memo = None
 
     # -- verification wrappers (validator_set.go:662-680) --------------------
 

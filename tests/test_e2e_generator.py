@@ -60,7 +60,8 @@ def test_full_profile_reaches_every_dimension():
     assert any(n["abci"] == "grpc" for n in nodes)
     for kt in ("ed25519", "secp256k1", "sr25519", "bn254"):
         assert any(n["key_type"] == kt for n in nodes), kt
-    for p in ("kill", "pause", "disconnect", "restart", "backend_faults"):
+    for p in ("kill", "pause", "disconnect", "restart", "backend_faults",
+              "concurrent_light_clients"):
         assert any(p in n["perturb"] for n in nodes), p
 
 

@@ -167,6 +167,40 @@ def test_sequential_mode():
     assert c.store.size() == 10
 
 
+def test_speculative_descent_is_bit_identical():
+    """The speculative prewarm (client._speculate_descent) only inserts
+    VALID triples into the verified cache, so the bisection must make the
+    same decisions with or without it: same pivots stored, same hashes,
+    same final block."""
+    from cometbft_tpu.crypto import ed25519 as _ed
+    from cometbft_tpu.light.client import Client as LClient
+
+    chain = ChainMaker(n_vals=4, heights=20, rotate=2)
+
+    def run():
+        _ed._verified.clear()
+        c = _client(chain)
+        lb = c.verify_light_block_at_height(20, NOW)
+        stored = sorted(
+            (h, c.store.light_block(h).hash().hex()) for h in c.store._heights()
+        )
+        return lb.hash().hex(), stored, c.speculation
+
+    orig = LClient._speculate_descent
+    LClient._speculate_descent = lambda self, current, stack: None
+    try:
+        base_hash, base_stored, base_spec = run()
+    finally:
+        LClient._speculate_descent = orig
+    spec_hash, spec_stored, spec = run()
+
+    assert base_spec == {"descents": 0, "prewarmed_sigs": 0}
+    assert spec["descents"] >= 1, "rotation must force a speculated descent"
+    assert spec["prewarmed_sigs"] > 0
+    assert spec_hash == base_hash
+    assert spec_stored == base_stored
+
+
 def test_expired_trusting_period():
     chain = ChainMaker(heights=5)
     c = _client(chain)

@@ -1,0 +1,406 @@
+"""Coalescing verification scheduler (sidecar/scheduler.py): concurrent
+requests merge into single dispatches with correct per-request bitmap
+slicing, a failed coalesced dispatch falls back to per-request retries
+(no cross-request poisoning), and chaos faults in one request's lane never
+flip a batchmate's verdict.  Seeded/deterministic, CPU-only — part of the
+`chaos` tier-1 group."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.sidecar import backend as backend_mod
+from cometbft_tpu.sidecar.backend import CpuBackend, VerifyBackend
+from cometbft_tpu.sidecar.chaos import ChaosBackend
+from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_cache():
+    ed25519._verified.clear()
+    yield
+    ed25519._verified.clear()
+
+
+def _signed(n, tag=b"sched"):
+    pvs = [ed25519.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    pubs = [pv.pub_key().bytes() for pv in pvs]
+    msgs = [b"msg-%d" % i for i in range(n)]
+    sigs = [pv.sign(m) for pv, m in zip(pvs, msgs)]
+    return pubs, msgs, sigs
+
+
+class _GateBackend(VerifyBackend):
+    """CpuBackend whose first call blocks until released — holds the
+    dispatcher busy so follow-up requests provably queue and coalesce."""
+
+    name = "gate"
+
+    def __init__(self):
+        self._cpu = CpuBackend()
+        self.release = threading.Event()
+        self.calls = []  # batch sizes, in dispatch order
+        self._first = True
+
+    def batch_verify(self, pubs, msgs, sigs):
+        self.calls.append(len(pubs))
+        if self._first:
+            self._first = False
+            self.release.wait(10.0)
+        return self._cpu.batch_verify(pubs, msgs, sigs)
+
+    def merkle_root(self, leaves):
+        return self._cpu.merkle_root(leaves)
+
+
+def test_single_request_passes_through():
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0)
+    try:
+        pubs, msgs, sigs = _signed(4)
+        ok, bits = sched.batch_verify(pubs, msgs, sigs)
+        assert ok and bits == [True] * 4
+        c = sched.counters()
+        assert c["requests"] == 1 and c["dispatches"] == 1
+        assert c["coalesced_dispatches"] == 0
+    finally:
+        sched.close()
+
+
+def test_empty_request_resolves_immediately():
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0)
+    try:
+        assert sched.batch_verify([], [], []) == (False, [])
+    finally:
+        sched.close()
+
+
+def test_concurrent_requests_coalesce_with_correct_slicing():
+    """Requests queued behind an in-flight dispatch merge into ONE backend
+    call, and each caller gets exactly its own bitmap back."""
+    gate = _GateBackend()
+    sched = CoalescingScheduler(gate, window_ms=0)
+    try:
+        p0, m0, s0 = _signed(2, tag=b"first")
+        fut0 = sched.submit(p0, m0, s0)
+        while not gate.calls:  # dispatcher now wedged inside call #1
+            time.sleep(0.001)
+        batches = [_signed(3, tag=b"req-%d" % i) for i in range(3)]
+        # poison one lane of request 1 only
+        batches[1][2][1] = b"\x01" * 64
+        futs = [sched.submit(p, m, s) for p, m, s in batches]
+        gate.release.set()
+        ok0, bits0 = fut0.result(10.0)
+        assert ok0 and bits0 == [True, True]
+        results = [f.result(10.0) for f in futs]
+        assert results[0] == (True, [True, True, True])
+        assert results[1] == (False, [True, False, True])
+        assert results[2] == (True, [True, True, True])
+        assert gate.calls == [2, 9], "queued requests must share one dispatch"
+        c = sched.counters()
+        assert c["coalesced_dispatches"] == 1
+        assert c["batched_requests"] == 3
+        assert c["fallback_splits"] == 0
+        assert c["coalesce_ratio"] == 2.0
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_identical_triples_share_lanes():
+    gate = _GateBackend()
+    sched = CoalescingScheduler(gate, window_ms=0)
+    try:
+        fut0 = sched.submit(*_signed(1, tag=b"warm"))
+        while not gate.calls:
+            time.sleep(0.001)
+        shared = _signed(4, tag=b"dup")
+        futs = [sched.submit(*shared) for _ in range(3)]
+        gate.release.set()
+        assert fut0.result(10.0)[0]
+        for f in futs:
+            assert f.result(10.0) == (True, [True] * 4)
+        assert gate.calls == [1, 4], "3x4 identical triples -> 4 lanes"
+        assert sched.counters()["dedup_sigs"] == 8
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_window_accumulates_burst_into_one_dispatch():
+    cpu = CpuBackend()
+    calls = []
+    orig = cpu.batch_verify
+    cpu.batch_verify = lambda p, m, s: calls.append(len(p)) or orig(p, m, s)
+    sched = CoalescingScheduler(cpu, window_ms=200)
+    try:
+        batches = [_signed(2, tag=b"burst-%d" % i) for i in range(4)]
+        start = threading.Barrier(4)
+
+        def go(b):
+            start.wait()
+            return sched.batch_verify(*b)
+
+        threads = []
+        results = [None] * 4
+        for i, b in enumerate(batches):
+            t = threading.Thread(
+                target=lambda i=i, b=b: results.__setitem__(i, go(b))
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(10.0)
+        assert all(r == (True, [True, True]) for r in results)
+        assert len(calls) == 1 and calls[0] == 8
+    finally:
+        sched.close()
+
+
+def test_max_sigs_caps_a_dispatch_without_splitting_requests():
+    gate = _GateBackend()
+    sched = CoalescingScheduler(gate, window_ms=0, max_sigs=5)
+    try:
+        fut0 = sched.submit(*_signed(1, tag=b"head"))
+        while not gate.calls:
+            time.sleep(0.001)
+        futs = [sched.submit(*_signed(3, tag=b"cap-%d" % i)) for i in range(3)]
+        gate.release.set()
+        assert fut0.result(10.0)[0]
+        for f in futs:
+            assert f.result(10.0) == (True, [True] * 3)
+        # 3x3 sigs under a 5-sig cap: one pair fits (3+3 > 5 -> actually
+        # only one whole request per dispatch once the first is in), and a
+        # request is never split across dispatches.
+        assert all(c in (1, 3, 6) for c in gate.calls)
+        assert sum(gate.calls) == 10
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_oversized_single_request_is_not_split():
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0, max_sigs=2)
+    try:
+        pubs, msgs, sigs = _signed(6, tag=b"big")
+        ok, bits = sched.batch_verify(pubs, msgs, sigs)
+        assert ok and bits == [True] * 6
+    finally:
+        sched.close()
+
+
+def test_submit_after_close_raises():
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0)
+    sched.close()
+    with pytest.raises(RuntimeError):
+        sched.submit(*_signed(1, tag=b"late"))
+
+
+# -- chaos: failed coalesced dispatches -----------------------------------
+
+
+class _FlakyBackend(VerifyBackend):
+    """Fails (or wedges, then fails) any MERGED dispatch; serves
+    request-sized batches — the shape of a sick tier that chokes on the
+    coalesced batch but can still answer its parts."""
+
+    name = "flaky"
+
+    def __init__(self, limit, wedge_ms=0.0):
+        self._cpu = CpuBackend()
+        self.limit = limit
+        self.wedge_ms = wedge_ms
+        self.calls = []
+
+    def batch_verify(self, pubs, msgs, sigs):
+        self.calls.append(len(pubs))
+        if len(pubs) > self.limit:
+            if self.wedge_ms:
+                time.sleep(self.wedge_ms / 1000.0)
+            raise ConnectionError("flaky: coalesced batch rejected")
+        return self._cpu.batch_verify(pubs, msgs, sigs)
+
+    def merkle_root(self, leaves):
+        return self._cpu.merkle_root(leaves)
+
+
+@pytest.mark.parametrize("wedge_ms", [0.0, 50.0])
+def test_failed_coalesced_dispatch_falls_back_per_request(wedge_ms):
+    """Error/wedge on the merged dispatch: every batchmate still gets its
+    own correct bitmap via per-request retries; the caller with the bad
+    signature is the only one who sees a False lane."""
+    flaky = _FlakyBackend(limit=3, wedge_ms=wedge_ms)
+    sched = CoalescingScheduler(flaky, window_ms=200)
+    try:
+        batches = [_signed(3, tag=b"fb-%d" % i) for i in range(3)]
+        batches[2][2][0] = b"\x02" * 64  # poison request 2, lane 0
+        start = threading.Barrier(3)
+        results = [None] * 3
+
+        def go(i):
+            start.wait()
+            results[i] = sched.batch_verify(*batches[i])
+
+        threads = [
+            threading.Thread(target=go, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+        assert results[0] == (True, [True] * 3)
+        assert results[1] == (True, [True] * 3)
+        assert results[2] == (False, [False, True, True])
+        c = sched.counters()
+        assert c["fallback_splits"] == 1
+        assert c["coalesced_dispatches"] == 1
+        # one failed merged call (9 lanes) + 3 per-request retries
+        assert flaky.calls[0] == 9 and sorted(flaky.calls[1:]) == [3, 3, 3]
+    finally:
+        sched.close()
+
+
+def test_poisoned_request_error_does_not_fail_batchmates():
+    """A request whose RETRY also fails (backend rejects even its solo
+    batch) errors alone; batchmates still resolve."""
+
+    class _Vetoing(VerifyBackend):
+        name = "veto"
+
+        def __init__(self):
+            self._cpu = CpuBackend()
+
+        def batch_verify(self, pubs, msgs, sigs):
+            if len(pubs) != 2 or any(s == b"\xee" * 64 for s in sigs):
+                raise ConnectionError("veto")
+            return self._cpu.batch_verify(pubs, msgs, sigs)
+
+        def merkle_root(self, leaves):
+            return self._cpu.merkle_root(leaves)
+
+    sched = CoalescingScheduler(_Vetoing(), window_ms=200)
+    try:
+        good = _signed(2, tag=b"ok")
+        poisoned = _signed(2, tag=b"poison")
+        poisoned[2][0] = b"\xee" * 64
+        start = threading.Barrier(2)
+        out = {}
+
+        def go(name, batch):
+            start.wait()
+            try:
+                out[name] = sched.batch_verify(*batch)
+            except Exception as e:
+                out[name] = e
+
+        threads = [
+            threading.Thread(target=go, args=("good", good)),
+            threading.Thread(target=go, args=("poisoned", poisoned)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+        assert out["good"] == (True, [True, True])
+        assert isinstance(out["poisoned"], ConnectionError)
+    finally:
+        sched.close()
+
+
+def test_chaos_error_faults_fall_back_per_request():
+    """CMTPU_FAULTS-style seeded chaos under the scheduler: injected errors
+    on merged dispatches degrade to per-request retries, verdicts stay
+    honest."""
+    chaos = ChaosBackend(CpuBackend(), "error:0.5", seed=7)
+    sched = CoalescingScheduler(chaos, window_ms=150)
+    try:
+        for round_i in range(4):
+            batches = [
+                _signed(2, tag=b"cr-%d-%d" % (round_i, i)) for i in range(3)
+            ]
+            batches[1][2][1] = b"\x03" * 64
+            start = threading.Barrier(3)
+            results = [None] * 3
+
+            def go(i):
+                start.wait()
+                try:
+                    results[i] = sched.batch_verify(*batches[i])
+                except ConnectionError:
+                    results[i] = "error"
+
+            threads = [
+                threading.Thread(target=go, args=(i,)) for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(15.0)
+            # Whatever the chaos draw did, a RESOLVED verdict is honest.
+            if results[0] != "error":
+                assert results[0] == (True, [True, True])
+            if results[1] != "error":
+                assert results[1] == (False, [True, False])
+            if results[2] != "error":
+                assert results[2] == (True, [True, True])
+    finally:
+        sched.close()
+
+
+def test_flip_fault_cannot_cross_request_boundaries():
+    """A flip-corrupted tier under the SUPERVISED chain, under the
+    scheduler: the cpu cross-check catches the false-accept, and the one
+    request carrying an invalid signature is the only one whose bitmap
+    shows it — a flip in its lane never flips a batchmate."""
+    flipping = ChaosBackend(CpuBackend(), "flip:1.0", seed=3)
+    flipping.name = "chaos-primary"
+    chain = ResilientBackend(
+        [("chaos-primary", flipping), ("cpu", CpuBackend())],
+        deadline_ms=0,
+        crosscheck="full",
+    )
+    sched = CoalescingScheduler(chain, window_ms=200)
+    try:
+        batches = [_signed(2, tag=b"flip-%d" % i) for i in range(3)]
+        batches[0][2][0] = b"\x04" * 64  # only request 0 is invalid
+        start = threading.Barrier(3)
+        results = [None] * 3
+
+        def go(i):
+            start.wait()
+            results[i] = sched.batch_verify(*batches[i])
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+        assert results[0] == (False, [False, True])
+        assert results[1] == (True, [True, True]), "batchmate must not flip"
+        assert results[2] == (True, [True, True]), "batchmate must not flip"
+    finally:
+        sched.close()
+
+
+def test_auto_backend_composition_strips_with_knob(monkeypatch):
+    monkeypatch.setenv("CMTPU_BACKEND", "auto")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("CMTPU_FAULTS", raising=False)
+    old = backend_mod._backend
+    try:
+        monkeypatch.setenv("CMTPU_COALESCE", "0")
+        backend_mod.set_backend(None)
+        bare = backend_mod.get_backend()
+        assert isinstance(bare, ResilientBackend)
+        monkeypatch.delenv("CMTPU_COALESCE")
+        backend_mod.set_backend(None)
+        sched = backend_mod.get_backend()
+        assert isinstance(sched, CoalescingScheduler)
+        assert isinstance(sched.inner, ResilientBackend)
+        sched.close()
+    finally:
+        backend_mod.set_backend(old)

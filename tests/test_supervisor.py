@@ -333,11 +333,17 @@ def test_auto_backend_is_supervised(monkeypatch):
     backend_mod.set_backend(None)
     try:
         b = backend_mod.get_backend()
-        assert isinstance(b, ResilientBackend)
+        # auto composes scheduler -> supervisor; the supervised chain is
+        # the scheduler's inner tier (CMTPU_COALESCE=0 strips the front).
+        from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+
+        assert isinstance(b, CoalescingScheduler)
+        assert isinstance(b.inner, ResilientBackend)
         pubs, msgs, sigs = _signed(3, tag=b"auto")
         ok, bits = b.batch_verify(pubs, msgs, sigs)
         assert ok and bits == [True] * 3
-        assert b.counters()["active_tier"] == "cpu"
+        assert b.counters()["inner"]["active_tier"] == "cpu"
+        b.close()
     finally:
         backend_mod.set_backend(old)
 
