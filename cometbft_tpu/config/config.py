@@ -160,6 +160,11 @@ class ConsensusConfig:
     peer_gossip_sleep_duration: float = 0.1
     peer_query_maj23_sleep_duration: float = 2.0
     double_sign_check_height: int = 0
+    # Stall watchdog: if no round-step progress for this many multiples of
+    # the current round's full escalated timeout budget, re-announce our
+    # round step and re-fire maj23 queries (0 disables). CMTPU_STALL_FACTOR
+    # env overrides at node start.
+    stall_watchdog_factor: float = 10.0
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_
@@ -172,6 +177,16 @@ class ConsensusConfig:
 
     def commit_time(self, t: float) -> float:
         return t + self.timeout_commit
+
+    def round_timeout_budget(self, round_: int) -> float:
+        """Worst-case wall time one full round can legitimately take at this
+        escalation level — the stall watchdog's unit of patience."""
+        return (
+            self.propose_timeout(round_)
+            + self.prevote_timeout(round_)
+            + self.precommit_timeout(round_)
+            + self.timeout_commit
+        )
 
     def wal_path(self) -> str:
         return os.path.join(self.root_dir, self.wal_file)

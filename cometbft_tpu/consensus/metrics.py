@@ -44,6 +44,9 @@ class Metrics:
             self.latest_block_height = _NOP
             self.block_interval_seconds = _NOP
             self.block_parts = _NOP
+            self.consensus_stalls_total = _NOP
+            self.round_catchup_votes_sent = _NOP
+            self.wal_replay_round = _NOP
             return
         sub = "consensus"
         self.height = registry.gauge(sub, "height", "Height of the chain.")
@@ -69,6 +72,22 @@ class Metrics:
         )
         self.block_parts = registry.counter(
             sub, "block_parts", "Block parts transmitted per peer.", labels=("peer_id",)
+        )
+        # Liveness hardening: stall watchdog + round-catchup gossip + WAL
+        # round restore (consensus/reactor.py pick cascade, state.py watchdog).
+        self.consensus_stalls_total = registry.counter(
+            sub, "stalls_total",
+            "Stall-watchdog firings: no round-step progress for the "
+            "escalated-timeout budget.",
+        )
+        self.round_catchup_votes_sent = registry.counter(
+            sub, "round_catchup_votes_sent",
+            "Votes gossiped to peers lagging in rounds (peer-round prevotes/"
+            "precommits, POL prevotes, last-commit precommits).",
+        )
+        self.wal_replay_round = registry.gauge(
+            sub, "wal_replay_round",
+            "Round restored from the WAL on the last mid-height restart.",
         )
 
 

@@ -13,7 +13,13 @@ import threading
 import time
 
 from cometbft_tpu.consensus import messages as cmsg
-from cometbft_tpu.consensus.cstypes import STEP_NAMES
+from cometbft_tpu.consensus.cstypes import (
+    STEP_NAMES,
+    STEP_NEW_HEIGHT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+)
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.p2p.reactor import (
     CONSENSUS_DATA_CHANNEL,
@@ -26,7 +32,12 @@ from cometbft_tpu.types.block import PRECOMMIT_TYPE
 
 
 class PeerState:
-    """reactor.go PeerState: the peer's view of consensus."""
+    """reactor.go PeerState: the peer's view of consensus.
+
+    Beyond height/round/step this tracks whether the peer has the current
+    proposal and that proposal's POL round (reactor.go SetHasProposal) —
+    the round-catchup gossip cascade needs both to feed a peer lagging in
+    ROUNDS the votes for *its* round instead of ours."""
 
     def __init__(self, peer):
         self.peer = peer
@@ -34,6 +45,8 @@ class PeerState:
         self.round = 0
         self.step = 0
         self.last_commit_round = 0
+        self.proposal = False
+        self.proposal_pol_round = -1
         self._mtx = threading.Lock()
         self._sent_parts: set = set()
         self._sent_votes: set = set()
@@ -43,10 +56,27 @@ class PeerState:
             if (msg.height, msg.round) != (self.height, self.round):
                 self._sent_parts.clear()
                 self._sent_votes.clear()
+                self.proposal = False
+                self.proposal_pol_round = -1
             self.height = msg.height
             self.round = msg.round
             self.step = msg.step
             self.last_commit_round = msg.last_commit_round
+
+    def set_has_proposal(self, proposal) -> None:
+        """reactor.go PeerState.SetHasProposal: the peer has (sent us, or
+        acked) the proposal for its current height/round."""
+        with self._mtx:
+            if (proposal.height, proposal.round) != (self.height, self.round):
+                return
+            self.proposal = True
+            self.proposal_pol_round = proposal.pol_round
+
+    def apply_proposal_pol(self, msg: cmsg.ProposalPOLMessage) -> None:
+        with self._mtx:
+            if msg.height != self.height:
+                return
+            self.proposal_pol_round = msg.proposal_pol_round
 
     def mark_part_sent(self, height: int, index: int) -> bool:
         with self._mtx:
@@ -83,6 +113,11 @@ class ConsensusReactor(Reactor):
         self._running = False
         # Own messages from the state machine get gossiped.
         self.cs.set_broadcast(self._broadcast_own_message)
+        # Stall watchdog (state.py): when the state machine detects no
+        # round-step progress, re-announce our position and re-advertise any
+        # 2/3 majorities so a desynced mesh can re-engage catch-up gossip.
+        if hasattr(self.cs, "set_on_stall"):
+            self.cs.set_on_stall(self._on_stall)
 
     def get_channels(self):
         """reactor.go:139-175 channel descriptors."""
@@ -157,6 +192,25 @@ class ConsensusReactor(Reactor):
                     ),
                 )
         elif chan_id in (CONSENSUS_DATA_CHANNEL, CONSENSUS_VOTE_CHANNEL):
+            # Bookkeeping first (reactor.go:249-297): whatever a peer SENDS
+            # us it already HAS — mark it so gossip never echoes it back,
+            # and learn the peer's proposal POL round for the vote cascade.
+            if ps is not None:
+                if isinstance(msg, cmsg.ProposalMessage):
+                    ps.set_has_proposal(msg.proposal)
+                    ps.mark_vote_sent(
+                        ("proposal", msg.proposal.height, msg.proposal.round)
+                    )
+                elif isinstance(msg, cmsg.ProposalPOLMessage):
+                    ps.apply_proposal_pol(msg)
+                    return  # peer-state only; not a state-machine input
+                elif isinstance(msg, cmsg.BlockPartMessage):
+                    ps.mark_part_sent(msg.height, msg.part.index)
+                elif isinstance(msg, cmsg.VoteMessage):
+                    v = msg.vote
+                    ps.mark_vote_sent(
+                        (v.height, v.round, v.type, v.validator_index)
+                    )
             self.cs.send_peer_message(msg, peer_id=peer.id)
         elif chan_id == CONSENSUS_VOTE_SET_BITS_CHANNEL:
             # The peer's answer to our VoteSetMaj23: which of those votes it
@@ -229,46 +283,62 @@ class ConsensusReactor(Reactor):
         """Tell peers at our height about any 2/3 majority we observe, so a
         lagging/partitioned peer learns a quorum exists and can answer with
         the votes it still needs (liveness under partial gossip)."""
-        from cometbft_tpu.types.block import PRECOMMIT_TYPE, PREVOTE_TYPE
-
         interval = getattr(
             self.cs.config, "peer_query_maj23_sleep_duration", 2.0
         )
         while self._running:
             time.sleep(interval)
-            rs = self.cs.rs
-            if rs.votes is None or self.switch is None:
+            self._query_maj23_once()
+
+    def _query_maj23_once(self) -> None:
+        from cometbft_tpu.types.block import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+        rs = self.cs.rs
+        if rs.votes is None or self.switch is None:
+            return
+        # Snapshot (height, round) ONCE: reading rs.round again per claim
+        # races the state machine — a round advance mid-loop would tag a
+        # majority with the wrong round, and the receiver treats
+        # conflicting claims from one peer as misbehavior.
+        height, round_ = rs.height, rs.round
+        claims = []
+        for vtype, vote_set in (
+            (PREVOTE_TYPE, rs.votes.prevotes(round_)),
+            (PRECOMMIT_TYPE, rs.votes.precommits(round_)),
+        ):
+            if vote_set is None:
                 continue
-            # Snapshot (height, round) ONCE: reading rs.round again per claim
-            # races the state machine — a round advance mid-loop would tag a
-            # majority with the wrong round, and the receiver treats
-            # conflicting claims from one peer as misbehavior.
-            height, round_ = rs.height, rs.round
-            claims = []
-            for vtype, vote_set in (
-                (PREVOTE_TYPE, rs.votes.prevotes(round_)),
-                (PRECOMMIT_TYPE, rs.votes.precommits(round_)),
-            ):
-                if vote_set is None:
-                    continue
-                block_id, ok = vote_set.two_thirds_majority()
-                if ok:
-                    claims.append((vtype, block_id))
-            if not claims:
+            block_id, ok = vote_set.two_thirds_majority()
+            if ok:
+                claims.append((vtype, block_id))
+        if not claims:
+            return
+        for ps in list(self.peer_states.values()):
+            if ps.height != height:
                 continue
-            for ps in list(self.peer_states.values()):
-                if ps.height != height:
-                    continue
-                for vtype, block_id in claims:
-                    ps.peer.try_send(
-                        CONSENSUS_STATE_CHANNEL,
-                        cmsg.encode_consensus_message(
-                            cmsg.VoteSetMaj23Message(
-                                height=height, round=round_, type=vtype,
-                                block_id=block_id,
-                            )
-                        ),
-                    )
+            for vtype, block_id in claims:
+                ps.peer.try_send(
+                    CONSENSUS_STATE_CHANNEL,
+                    cmsg.encode_consensus_message(
+                        cmsg.VoteSetMaj23Message(
+                            height=height, round=round_, type=vtype,
+                            block_id=block_id,
+                        )
+                    ),
+                )
+
+    # -- stall recovery (state.py watchdog callback) ---------------------------
+
+    def _on_stall(self) -> None:
+        """Stall-watchdog hook: loudly re-announce our round step (the lossy
+        broadcast may have dropped it) and re-advertise observed majorities.
+        Both are idempotent; the receivers dedupe via PeerState marks."""
+        if self.switch is not None:
+            self.switch.broadcast(
+                CONSENSUS_STATE_CHANNEL,
+                cmsg.encode_consensus_message(self._round_step_msg(self.cs.rs)),
+            )
+        self._query_maj23_once()
 
     # -- per-peer gossip (reactor.go:535 gossipDataRoutine + :694 votes) ------
 
@@ -283,98 +353,187 @@ class ConsensusReactor(Reactor):
 
     def _gossip_once(self, ps: PeerState) -> bool:
         rs = self.cs.rs
-        # Peer behind: feed them committed block parts + the seen commit's
-        # precommits so they can catch up (gossipDataForCatchup).
+        # Peer behind in HEIGHTS: committed block parts + seen-commit
+        # precommits from the block store (gossipDataForCatchup).
         if 0 < ps.height < rs.height:
-            block_meta = self.cs.block_store.load_block_meta(ps.height)
-            if block_meta is None:
-                return False
-            sent = False
-            for i in range(block_meta.block_id.part_set_header.total):
-                if ps.mark_part_sent(ps.height, i):
-                    part = self.cs.block_store.load_block_part(ps.height, i)
-                    # A full send queue drops the message: un-mark so the
-                    # next gossip pass retries instead of losing the part
-                    # forever (liveness under backpressure).
-                    if part is not None and ps.peer.try_send(
+            return self._gossip_height_catchup(ps, rs)
+        # Same height: proposal/parts for the matching round, then the vote
+        # pick cascade for a peer behind in ROUNDS.
+        if ps.height == rs.height:
+            sent = self._gossip_data(ps, rs)
+            return self._gossip_votes(ps, rs) or sent
+        return False
+
+    def _gossip_height_catchup(self, ps: PeerState, rs) -> bool:
+        block_meta = self.cs.block_store.load_block_meta(ps.height)
+        if block_meta is None:
+            # Store already pruned / not yet saved. If the peer is exactly
+            # one height behind, our live last_commit still holds the
+            # precommits it needs to finish (gossipVotesRoutine's
+            # rs.Height == prs.Height+1 pick).
+            if rs.height == ps.height + 1 and rs.last_commit is not None:
+                return self._pick_send_vote(ps, rs.last_commit, catchup=True)
+            return False
+        sent = False
+        for i in range(block_meta.block_id.part_set_header.total):
+            if ps.mark_part_sent(ps.height, i):
+                part = self.cs.block_store.load_block_part(ps.height, i)
+                # A full send queue drops the message: un-mark so the
+                # next gossip pass retries instead of losing the part
+                # forever (liveness under backpressure).
+                if part is not None and ps.peer.try_send(
+                    CONSENSUS_DATA_CHANNEL,
+                    cmsg.encode_consensus_message(
+                        cmsg.BlockPartMessage(ps.height, ps.round, part)
+                    ),
+                ):
+                    sent = True
+                else:
+                    ps.unmark_part_sent(ps.height, i)
+        seen_commit = self.cs.block_store.load_seen_commit(ps.height)
+        if seen_commit is not None:
+            from cometbft_tpu.types.vote import Vote
+
+            for idx, cs_sig in enumerate(seen_commit.signatures):
+                if cs_sig.is_absent():
+                    continue
+                key = ("commit", ps.height, idx)
+                if not ps.mark_vote_sent(key):
+                    continue
+                vote = Vote(
+                    type=PRECOMMIT_TYPE,
+                    height=seen_commit.height,
+                    round=seen_commit.round,
+                    block_id=cs_sig.block_id(seen_commit.block_id),
+                    timestamp=cs_sig.timestamp,
+                    validator_address=cs_sig.validator_address,
+                    validator_index=idx,
+                    signature=cs_sig.signature,
+                )
+                if ps.peer.try_send(
+                    CONSENSUS_VOTE_CHANNEL,
+                    cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
+                ):
+                    sent = True
+                    self.cs.metrics.round_catchup_votes_sent.inc()
+                else:
+                    ps.unmark_vote_sent(key)
+        return sent
+
+    def _gossip_data(self, ps: PeerState, rs) -> bool:
+        """Same-height data gossip (gossipDataRoutine): proposal + parts +
+        ProposalPOL when the peer is at our round."""
+        if rs.proposal is None or ps.round != rs.round:
+            return False
+        sent = False
+        key = ("proposal", rs.height, rs.round)
+        if ps.mark_vote_sent(key):
+            if ps.peer.try_send(
+                CONSENSUS_DATA_CHANNEL,
+                cmsg.encode_consensus_message(cmsg.ProposalMessage(rs.proposal)),
+            ):
+                sent = True
+                ps.set_has_proposal(rs.proposal)
+                # reactor.go:600-612: a POL proposal is useless without the
+                # POL round hint — send ProposalPOL right behind it. Best
+                # effort: the cascade's POL branch re-serves the votes.
+                if rs.proposal.pol_round >= 0 and rs.votes is not None:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        ps.peer.try_send(
+                            CONSENSUS_DATA_CHANNEL,
+                            cmsg.encode_consensus_message(
+                                cmsg.ProposalPOLMessage(
+                                    height=rs.height,
+                                    proposal_pol_round=rs.proposal.pol_round,
+                                    proposal_pol=pol.bit_array(),
+                                )
+                            ),
+                        )
+            else:
+                ps.unmark_vote_sent(key)
+        if rs.proposal_block_parts is not None:
+            for i in range(rs.proposal_block_parts.total):
+                part = rs.proposal_block_parts.get_part(i)
+                if part is not None and ps.mark_part_sent(rs.height, i):
+                    if ps.peer.try_send(
                         CONSENSUS_DATA_CHANNEL,
                         cmsg.encode_consensus_message(
-                            cmsg.BlockPartMessage(ps.height, ps.round, part)
+                            cmsg.BlockPartMessage(rs.height, rs.round, part)
                         ),
                     ):
                         sent = True
                     else:
-                        ps.unmark_part_sent(ps.height, i)
-            seen_commit = self.cs.block_store.load_seen_commit(ps.height)
-            if seen_commit is not None:
-                from cometbft_tpu.types.vote import Vote
+                        ps.unmark_part_sent(rs.height, i)
+        return sent
 
-                for idx, cs_sig in enumerate(seen_commit.signatures):
-                    if cs_sig.is_absent():
-                        continue
-                    key = ("commit", ps.height, idx)
-                    if not ps.mark_vote_sent(key):
-                        continue
-                    vote = Vote(
-                        type=PRECOMMIT_TYPE,
-                        height=seen_commit.height,
-                        round=seen_commit.round,
-                        block_id=cs_sig.block_id(seen_commit.block_id),
-                        timestamp=cs_sig.timestamp,
-                        validator_address=cs_sig.validator_address,
-                        validator_index=idx,
-                        signature=cs_sig.signature,
-                    )
-                    if ps.peer.try_send(
-                        CONSENSUS_VOTE_CHANNEL,
-                        cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
-                    ):
-                        sent = True
-                    else:
-                        ps.unmark_vote_sent(key)
-            return sent
-        # Same height: re-send our proposal/parts and known votes they lack.
-        if ps.height == rs.height:
-            sent = False
-            if rs.proposal is not None and ps.round == rs.round:
-                key = ("proposal", rs.height, rs.round)
-                if ps.mark_vote_sent(key):
-                    if ps.peer.try_send(
-                        CONSENSUS_DATA_CHANNEL,
-                        cmsg.encode_consensus_message(cmsg.ProposalMessage(rs.proposal)),
-                    ):
-                        sent = True
-                    else:
-                        ps.unmark_vote_sent(key)
-                if rs.proposal_block_parts is not None:
-                    for i in range(rs.proposal_block_parts.total):
-                        part = rs.proposal_block_parts.get_part(i)
-                        if part is not None and ps.mark_part_sent(rs.height, i):
-                            if ps.peer.try_send(
-                                CONSENSUS_DATA_CHANNEL,
-                                cmsg.encode_consensus_message(
-                                    cmsg.BlockPartMessage(rs.height, rs.round, part)
-                                ),
-                            ):
-                                sent = True
-                            else:
-                                ps.unmark_part_sent(rs.height, i)
-            if rs.votes is not None:
-                for vote_set in (
-                    rs.votes.prevotes(rs.round),
-                    rs.votes.precommits(rs.round),
-                ):
-                    if vote_set is None:
-                        continue
-                    for vote in vote_set.list_votes():
-                        key = (vote.height, vote.round, vote.type, vote.validator_index)
-                        if ps.mark_vote_sent(key):
-                            if ps.peer.try_send(
-                                CONSENSUS_VOTE_CHANNEL,
-                                cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
-                            ):
-                                sent = True
-                            else:
-                                ps.unmark_vote_sent(key)
-            return sent
+    def _gossip_votes(self, ps: PeerState, rs) -> bool:
+        """The reference's gossipVotesForHeight pick cascade (reactor.go:740-
+        802): serve the votes for the PEER'S position, not ours. A peer
+        lagging in rounds gets its-round prevotes/precommits (so it can climb
+        back to the live round after a restart), a peer holding a POL
+        proposal gets the POL-round prevotes, and a peer still in NewHeight
+        gets our last-commit precommits. Without this cascade a node
+        restarted mid-height re-enters round 0 and — when its voting power
+        is needed for quorum — the whole network round-livelocks."""
+        if rs.votes is None:
+            return False
+        # 1. Peer just entered this height: it needs the previous height's
+        #    precommits (our last_commit) to build its own LastCommit.
+        if ps.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+            if self._pick_send_vote(ps, rs.last_commit, catchup=True):
+                return True
+        behind = ps.round < rs.round
+        # 2. Peer stuck in Propose holding a POL proposal: POL prevotes.
+        if ps.step <= STEP_PROPOSE and 0 <= ps.proposal_pol_round <= rs.round:
+            pol = rs.votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(ps, pol, catchup=True):
+                return True
+        # 3. Peer in/below PrevoteWait: prevotes for ITS round.
+        if ps.step <= STEP_PREVOTE_WAIT and 0 <= ps.round <= rs.round:
+            pv = rs.votes.prevotes(ps.round)
+            if pv is not None and self._pick_send_vote(ps, pv, catchup=behind):
+                return True
+        # 4. Peer in/below PrecommitWait: precommits for ITS round.
+        if ps.step <= STEP_PRECOMMIT_WAIT and 0 <= ps.round <= rs.round:
+            pc = rs.votes.precommits(ps.round)
+            if pc is not None and self._pick_send_vote(ps, pc, catchup=behind):
+                return True
+        # 5. Catchall by round: any prevotes for the peer's round.
+        if 0 <= ps.round <= rs.round:
+            pv = rs.votes.prevotes(ps.round)
+            if pv is not None and self._pick_send_vote(ps, pv, catchup=behind):
+                return True
+        # 6. POL prevotes regardless of step.
+        if 0 <= ps.proposal_pol_round <= rs.round:
+            pol = rs.votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(ps, pol, catchup=True):
+                return True
+        # 7. Fallback (pre-cascade behavior): our current round's votes —
+        #    lets a lagging peer observe a +2/3-any future round and skip
+        #    forward, and covers ps.step values outside the cascade.
+        sent = False
+        for vote_set in (rs.votes.prevotes(rs.round), rs.votes.precommits(rs.round)):
+            if vote_set is not None and self._pick_send_vote(ps, vote_set):
+                sent = True
+        return sent
+
+    def _pick_send_vote(self, ps: PeerState, vote_set, catchup: bool = False) -> bool:
+        """reactor.go PickSendVote: send ONE vote from vote_set the peer
+        doesn't have yet. On a full send queue the mark is unwound so the
+        next gossip pass retries (mark/unmark symmetry — liveness under
+        backpressure)."""
+        for vote in vote_set.list_votes():
+            key = (vote.height, vote.round, vote.type, vote.validator_index)
+            if not ps.mark_vote_sent(key):
+                continue
+            if ps.peer.try_send(
+                CONSENSUS_VOTE_CHANNEL,
+                cmsg.encode_consensus_message(cmsg.VoteMessage(vote)),
+            ):
+                if catchup:
+                    self.cs.metrics.round_catchup_votes_sent.inc()
+                return True
+            ps.unmark_vote_sent(key)
+            return False  # queue full: back off, retry next pass
         return False

@@ -13,8 +13,10 @@ locking/unlocking rules and valid-block tracking.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 import traceback
 from dataclasses import replace
 
@@ -42,7 +44,12 @@ from cometbft_tpu.consensus.messages import (
 from cometbft_tpu.consensus.ticker import TimeoutTicker
 from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
 from cometbft_tpu.libs import fail
+from cometbft_tpu.privval.file import (
+    STEP_PRECOMMIT as PV_STEP_PRECOMMIT,
+    STEP_PREVOTE as PV_STEP_PREVOTE,
+)
 from cometbft_tpu.types import cmttime, events as ev
+from cometbft_tpu.types.canonical import decode_canonical_vote
 from cometbft_tpu.types.block import (
     PRECOMMIT_TYPE,
     PREVOTE_TYPE,
@@ -124,6 +131,17 @@ class ConsensusState:
         self._thread: threading.Thread | None = None
         self._broadcast = None  # fn(msg) -> None: reactor / test harness hook
         self._height_events = threading.Condition()
+        # Stall watchdog: no round-step progress for stall_factor × the
+        # current round's full timeout budget ⇒ re-announce + re-arm.
+        self._on_stall = None  # reactor hook: fn() -> None
+        self._last_progress = time.monotonic()
+        self._stall_factor = getattr(config, "stall_watchdog_factor", 10.0)
+        env_factor = os.environ.get("CMTPU_STALL_FACTOR")
+        if env_factor:
+            try:
+                self._stall_factor = float(env_factor)
+            except ValueError:
+                pass
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
@@ -140,6 +158,11 @@ class ConsensusState:
         """Reactor hook: called with every own message to gossip
         (ProposalMessage / BlockPartMessage / VoteMessage)."""
         self._broadcast = fn
+
+    def set_on_stall(self, fn) -> None:
+        """Reactor hook: called (from the watchdog thread) when no round-step
+        progress has been made for the stall budget."""
+        self._on_stall = fn
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -158,7 +181,20 @@ class ConsensusState:
         self._tock_pump.start()
         self._thread = threading.Thread(target=self._receive_routine, daemon=True)
         self._thread.start()
-        self._schedule_round0()
+        if self.rs.round == 0 and self.rs.step == STEP_NEW_HEIGHT:
+            self._schedule_round0()
+        else:
+            # WAL replay restored a later round/step: a round-0 NEW_HEIGHT
+            # timeout would be discarded by _handle_timeout AND (single-timer
+            # ticker) would clobber the restored step's pending timer — re-arm
+            # the timer the restored step actually needs.
+            with self._mtx:
+                self._rearm_step_timeout()
+        self._last_progress = time.monotonic()
+        if self._stall_factor > 0:
+            threading.Thread(
+                target=self._stall_watchdog_routine, daemon=True
+            ).start()
 
     def _wal_catchup_with_repair(self) -> None:
         """state.go:320-370: catchupReplay, with a one-shot corrupted-WAL
@@ -223,14 +259,167 @@ class ConsensusState:
                     f"cannot replay height {cs_height}: WAL has no #ENDHEIGHT "
                     f"for {end_height}"
                 )
+            msgs = list(msgs)
+            # Restore the ROUND reached before the crash, not round 0. Only
+            # own messages (write_sync, fsynced) and our own ticker's
+            # timeouts are trusted for this — a garbage peer vote in the
+            # buffered WAL tail must not drag us to an arbitrary round.
+            wal_round = self._scan_wal_round(msgs, cs_height)
+            if wal_round > 0:
+                # Enter BEFORE replaying: _set_proposal only accepts the
+                # proposal for rs.round, and entering pre-creates the vote
+                # sets so own votes from intermediate rounds land instead of
+                # tripping HeightVoteSet's 2-catchup-round peer limit.
+                with self._mtx:
+                    self.rs.votes.set_round(wal_round + 1)
+                    self._enter_new_round(cs_height, wal_round)
             n = 0
             for tm in msgs:
                 self._read_replay_message(tm)
                 n += 1
+            # Message replay alone leaves the step wherever vote majorities
+            # drove it; if our own recorded votes prove we got further
+            # (peer votes/timeouts are buffered writes and die with a
+            # SIGKILL), re-enter those steps. replay_mode swallows the
+            # double-sign refusals; identical re-signs rebroadcast our votes.
+            with self._mtx:
+                self._recover_privval_vote(cs_height)
+                self._restore_wal_step(cs_height)
+            self.metrics.wal_replay_round.set(self.rs.round)
             if n:
-                self._log(f"WAL catchup: replayed {n} messages at height {cs_height}")
+                self._log(
+                    f"WAL catchup: replayed {n} messages at height {cs_height}"
+                    f" (round {self.rs.round})"
+                )
         finally:
             self.replay_mode = False
+
+    def _scan_wal_round(self, msgs, cs_height: int) -> int:
+        """Highest round provably reached before the crash: our own signed
+        votes (fsynced before processing) and our own ticker's timeouts."""
+        own_addr = (
+            self.priv_validator_pub_key.address()
+            if self.priv_validator_pub_key is not None
+            else None
+        )
+        wal_round = 0
+        for tm in msgs:
+            msg = tm.msg
+            if isinstance(msg, TimeoutInfo) and msg.height == cs_height:
+                wal_round = max(wal_round, msg.round)
+            elif (
+                isinstance(msg, VoteMessage)
+                and msg.vote.height == cs_height
+                and own_addr is not None
+                and msg.vote.validator_address == own_addr
+            ):
+                wal_round = max(wal_round, msg.vote.round)
+        # The privval fsyncs its last-sign state BEFORE the vote reaches the
+        # WAL (sign_vote persists, then _send_internal queues the write), so
+        # a crash in that window leaves a signed round the WAL never saw.
+        # The sign state is as trustworthy as our own fsynced votes.
+        lss = getattr(self.priv_validator, "last_sign_state", None)
+        if (
+            lss is not None
+            and getattr(lss, "height", None) == cs_height
+            and getattr(lss, "step", 0) in (PV_STEP_PREVOTE, PV_STEP_PRECOMMIT)
+        ):
+            wal_round = max(wal_round, lss.round)
+        return wal_round
+
+    def _recover_privval_vote(self, cs_height: int) -> None:
+        """Re-publish the privval's last signed vote when the WAL lost it.
+
+        A crash between FilePV's fsync and the WAL's write_sync leaves the
+        privval remembering a vote this node never recorded or broadcast.
+        After restart the double-sign guard then refuses to vote at that
+        (height, round, step) — correctly — but the round's quorum may be
+        impossible without this validator's power, livelocking the whole
+        network at that round. The persisted sign_bytes + signature are
+        enough to reconstruct the exact vote; feeding it back through
+        _send_internal fsyncs it to the WAL, broadcasts it to peers, and
+        adds it to our own vote set like any other own vote."""
+        pv = self.priv_validator
+        lss = getattr(pv, "last_sign_state", None)
+        if lss is None or not getattr(lss, "sign_bytes", None):
+            return
+        if not getattr(lss, "signature", None):
+            return
+        if lss.height != cs_height or lss.step not in (
+            PV_STEP_PREVOTE,
+            PV_STEP_PRECOMMIT,
+        ):
+            return
+        rs = self.rs
+        if rs.height != cs_height or rs.votes is None:
+            return
+        if self.priv_validator_pub_key is None:
+            return
+        own_addr = self.priv_validator_pub_key.address()
+        if not rs.validators.has_address(own_addr):
+            return
+        vote_set = (
+            rs.votes.prevotes(lss.round)
+            if lss.step == PV_STEP_PREVOTE
+            else rs.votes.precommits(lss.round)
+        )
+        if vote_set is None or vote_set.get_by_address(own_addr) is not None:
+            return  # WAL replay already restored it
+        try:
+            msg_type, height, round_, block_id, ts = decode_canonical_vote(
+                lss.sign_bytes
+            )
+        except Exception as e:
+            self._log(f"cannot decode privval last sign bytes: {e}")
+            return
+        if height != cs_height or round_ != lss.round:
+            return
+        idx, _ = rs.validators.get_by_address(own_addr)
+        vote = Vote(
+            type=msg_type,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=ts,
+            validator_address=own_addr,
+            validator_index=idx,
+            signature=lss.signature,
+        )
+        self._send_internal(VoteMessage(vote))
+        self._log(
+            f"recovered last signed vote from privval state "
+            f"(h={height} r={round_} type={msg_type})"
+        )
+
+    def _restore_wal_step(self, cs_height: int) -> None:
+        """Re-enter prevote/precommit at the restored round when the WAL
+        holds our own vote for that step (replay-mode only)."""
+        rs = self.rs
+        if rs.height != cs_height or rs.votes is None:
+            return
+        if self.priv_validator_pub_key is None:
+            return
+        own_addr = self.priv_validator_pub_key.address()
+        prevotes = rs.votes.prevotes(rs.round)
+        precommits = rs.votes.precommits(rs.round)
+        prevoted = prevotes is not None and prevotes.get_by_address(own_addr) is not None
+        precommitted = (
+            precommits is not None and precommits.get_by_address(own_addr) is not None
+        )
+        # The privval sign state is fsynced before the WAL write, so it can
+        # prove a step the WAL lost (see _recover_privval_vote).
+        lss = getattr(self.priv_validator, "last_sign_state", None)
+        if (
+            lss is not None
+            and getattr(lss, "height", None) == cs_height
+            and lss.round == rs.round
+        ):
+            prevoted = prevoted or lss.step >= PV_STEP_PREVOTE
+            precommitted = precommitted or lss.step >= PV_STEP_PRECOMMIT
+        if prevoted:
+            self._enter_prevote(cs_height, rs.round)
+        if precommitted:
+            self._enter_precommit(cs_height, rs.round)
 
     def _read_replay_message(self, tm) -> None:
         """replay.go:36-90 readReplayMessage: route one TimedWALMessage back
@@ -461,6 +650,7 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
+        self._last_progress = time.monotonic()
         with self._height_events:
             self._height_events.notify_all()
 
@@ -515,8 +705,77 @@ class ConsensusState:
         self.ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
 
     def _new_step(self) -> None:
+        self._last_progress = time.monotonic()
         if self.event_bus:
             self.event_bus.publish_new_round_step(self.rs.round_state_event())
+
+    # -- stall watchdog -------------------------------------------------------
+
+    def _stall_watchdog_routine(self) -> None:
+        """If the round state makes no progress for _stall_factor × the
+        current round's full (escalated) timeout budget, assume our
+        announcements or timers were lost: re-broadcast our round step +
+        observed majorities through the reactor hook and re-arm the current
+        step's timeout. Every action is idempotent, so a false positive
+        costs a few duplicate messages, never safety."""
+        while self._running:
+            time.sleep(0.05)
+            factor = self._stall_factor
+            if factor <= 0:
+                continue
+            rs = self.rs
+            # Waiting for transactions is idle by design, not a stall.
+            if not self.config.create_empty_blocks and rs.step == STEP_NEW_ROUND:
+                self._last_progress = time.monotonic()
+                continue
+            budget = self.config.round_timeout_budget(rs.round) * factor
+            idle = time.monotonic() - self._last_progress
+            if idle < budget:
+                continue
+            self._last_progress = time.monotonic()  # re-arm before acting
+            self.metrics.consensus_stalls_total.inc()
+            self._log(
+                f"stall watchdog: no progress for {idle:.1f}s at "
+                f"{rs.height}/{rs.round}/{cstypes.STEP_NAMES.get(rs.step, rs.step)}"
+                "; re-announcing round state"
+            )
+            cb = self._on_stall
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+            try:
+                with self._mtx:
+                    self._rearm_step_timeout()
+            except Exception:
+                pass
+
+    def _rearm_step_timeout(self) -> None:
+        """Re-schedule the timeout the CURRENT step depends on (the ticker
+        keeps a single pending timer, so a lost/clobbered tock would
+        otherwise leave the step waiting forever). Steps that legitimately
+        wait on votes/parts (Prevote, Precommit without 2/3-any, Commit)
+        have no timer to re-arm."""
+        rs = self.rs
+        if rs.step == STEP_NEW_HEIGHT:
+            self._schedule_round0()
+        elif rs.step in (STEP_NEW_ROUND, STEP_PROPOSE):
+            if rs.step == STEP_NEW_ROUND and not self.config.create_empty_blocks:
+                return  # waiting for txs: no timer by design
+            self._schedule_timeout(
+                self.config.propose_timeout(rs.round), rs.height, rs.round, STEP_PROPOSE
+            )
+        elif rs.step == STEP_PREVOTE_WAIT:
+            self._schedule_timeout(
+                self.config.prevote_timeout(rs.round),
+                rs.height, rs.round, STEP_PREVOTE_WAIT,
+            )
+        elif rs.step == STEP_PRECOMMIT and rs.triggered_timeout_precommit:
+            self._schedule_timeout(
+                self.config.precommit_timeout(rs.round),
+                rs.height, rs.round, STEP_PRECOMMIT_WAIT,
+            )
 
     # -- transitions ----------------------------------------------------------
 
@@ -534,6 +793,7 @@ class ConsensusState:
         rs.round = round_
         rs.step = STEP_NEW_ROUND
         rs.validators = validators
+        self._last_progress = time.monotonic()
         self.metrics.rounds.set(round_)
         if round_ != 0:
             rs.proposal = None
@@ -624,9 +884,13 @@ class ConsensusState:
         )
         try:
             proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
-        except Exception:
+        except Exception as e:
             if not self.replay_mode:
-                raise
+                # Same contract as _sign_add_vote: log and skip, never
+                # propagate a privval refusal into the step machinery.
+                self._log(
+                    f"failed signing proposal h={height} r={round_}: {e}"
+                )
             return
         self._send_internal(ProposalMessage(proposal))
         for i in range(block_parts.total):
@@ -1115,9 +1379,18 @@ class ConsensusState:
         )
         try:
             vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
-        except Exception:
+        except Exception as e:
             if not self.replay_mode:
-                raise
+                # state.go:2270 "failed signing vote": a refusing privval
+                # (double-sign guard, remote signer down) must never abort a
+                # step transition — _enter_prevote/_enter_precommit set
+                # rs.step AFTER the vote goes out, so a raise here would
+                # re-enter the same step forever and wedge the round. The
+                # node simply doesn't vote this step.
+                self._log(
+                    f"failed signing vote h={rs.height} r={rs.round} "
+                    f"type={msg_type}: {e}"
+                )
             return None
         self._send_internal(VoteMessage(vote))
         return vote

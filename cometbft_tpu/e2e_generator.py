@@ -255,17 +255,40 @@ def run_matrix(
             report = runner.run()
         except Exception as e:
             repro_path = _write_repro(sdir, seed, profile, text, e, runner)
+            # A wait_height deadline (TimeoutError) is the stall signature —
+            # height stopped advancing, i.e. a consensus livelock or a dead
+            # node — distinct from invariant failures (hash disagreement...).
+            stalled = isinstance(e, TimeoutError)
             log(f"matrix seed {seed}: FAILED ({e!r}); repro at {repro_path}")
-            results[seed] = {"ok": False, "error": repr(e), "repro": repro_path}
+            results[seed] = {
+                "ok": False,
+                "stalled": stalled,
+                "error": repr(e),
+                "repro": repro_path,
+            }
         else:
             results[seed] = {"ok": True, "report": report}
             log(f"matrix seed {seed}: ok at height {report['agreed_height']}")
     passed = sorted(s for s, r in results.items() if r["ok"])
     failed = sorted(s for s, r in results.items() if not r["ok"])
+    stalled = sorted(s for s, r in results.items() if r.get("stalled"))
+    # One grep-able line per sweep for tpu_watch.log: per-seed verdicts.
+    verdicts = " ".join(
+        f"seed{s}:" + (
+            "ok" if results[s]["ok"]
+            else ("stall" if results[s].get("stalled") else "fail")
+        )
+        for s in sorted(results)
+    )
+    log(
+        f"e2e matrix summary [{profile}]: {len(passed)}/{len(results)} passed,"
+        f" {len(stalled)} stalled | {verdicts}"
+    )
     return {
         "profile": profile,
         "passed": passed,
         "failed": failed,
+        "stalled": stalled,
         "results": {str(s): r for s, r in results.items()},
     }
 
@@ -289,6 +312,10 @@ def _write_repro(sdir, seed, profile, manifest_text, exc, runner) -> str:
         "error": repr(exc),
         "traceback": traceback.format_exc(),
         "node_logs": logs,
+        # Per-node consensus round-state at the moment the stall was
+        # detected (None for non-stall failures): height/round/step,
+        # per-round vote bitmaps, peer round views.
+        "round_states": getattr(runner, "last_round_states", None),
     }
     path = os.path.join(sdir, "repro.json")
     with open(path, "w") as f:

@@ -197,6 +197,10 @@ class E2ERunner:
         # Per-node results of the concurrent_light_clients perturbation
         # (swarm agreement + the runner-process coalesce counter deltas).
         self._light_swarms: dict[str, dict] = {}
+        # Stall forensics: every node's consensus round-state, captured at
+        # the moment a wait_height deadline expires (the nodes are SIGKILLed
+        # during teardown, so this is the only window to collect it).
+        self.last_round_states: dict | None = None
 
     # -- setup ------------------------------------------------------------
 
@@ -416,7 +420,29 @@ class E2ERunner:
             except Exception:
                 pass
             time.sleep(0.3)
+        self.last_round_states = self.dump_round_states()
         raise TimeoutError(f"{name}: height {target} not reached (last {last})")
+
+    def dump_round_states(self) -> dict:
+        """Every live node's dump_consensus_state — height/round/step,
+        per-round vote bitmaps, and peer round views. A round-livelock is
+        diagnosable from this alone: who is stuck at which round, holding
+        whose votes."""
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        out: dict = {}
+        for node in self.manifest.nodes:
+            port = self.rpc_ports.get(node.name)
+            if port is None:
+                continue
+            try:
+                dump = HTTPClient(
+                    f"http://127.0.0.1:{port}", timeout=3
+                ).dump_consensus_state()
+            except Exception as e:
+                dump = {"unreachable": repr(e)}
+            out[node.name] = dump
+        return out
 
     # -- perturbations (runner/perturb.go) --------------------------------
 

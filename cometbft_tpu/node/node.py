@@ -235,7 +235,10 @@ class Node:
                 MultiplexTransport(self.node_info, self.node_key, fuzz_config),
                 config=config.p2p,
             )
-            self.consensus_reactor = ConsensusReactor(self.consensus_state)
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus_state,
+                gossip_sleep=config.consensus.peer_gossip_sleep_duration,
+            )
             self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
             self.evidence_reactor = EvidenceReactor(self.evidence_pool)
             self.blocksync_reactor = BlocksyncReactor(
@@ -425,6 +428,7 @@ class Node:
                 state_store=self.state_store,
                 block_store=self.block_store,
                 consensus_state=self.consensus_state,
+                consensus_reactor=getattr(self, "consensus_reactor", None),
                 mempool=self.mempool,
                 evidence_pool=self.evidence_pool,
                 event_bus=self.event_bus,

@@ -28,6 +28,7 @@ class Environment:
     state_store: object = None
     block_store: object = None
     consensus_state: object = None
+    consensus_reactor: object = None  # peer round-state introspection
     mempool: object = None
     evidence_pool: object = None
     event_bus: EventBus | None = None
@@ -331,24 +332,64 @@ def routes(env: Environment) -> dict:
         }
 
     def dump_consensus_state():
+        from cometbft_tpu.consensus.cstypes import STEP_NAMES
+
         cs = env.consensus_state
         rs = cs.rs
+        # Per-round vote-set bitmaps up to the live round: the stall
+        # forensics dump — which validators' votes each node holds per
+        # round — is what makes a round-livelock diagnosable from a
+        # repro.json alone (rpc/core/consensus.go DumpConsensusState).
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes_bit_array": repr(pv.bit_array()) if pv else "",
+                        "precommits_bit_array": repr(pc.bit_array()) if pc else "",
+                    }
+                )
+        peers = []
+        reactor = env.consensus_reactor
+        if reactor is not None:
+            for peer_id, ps in list(
+                getattr(reactor, "peer_states", {}).items()
+            ):
+                peers.append(
+                    {
+                        "node_address": peer_id,
+                        "peer_state": {
+                            "height": str(ps.height),
+                            "round": ps.round,
+                            "step": STEP_NAMES.get(ps.step, ps.step),
+                            "proposal": ps.proposal,
+                            "proposal_pol_round": ps.proposal_pol_round,
+                        },
+                    }
+                )
         return {
             "round_state": {
                 "height": str(rs.height),
                 "round": rs.round,
                 "step": rs.step,
+                "step_name": STEP_NAMES.get(rs.step, str(rs.step)),
                 "start_time": rs.start_time.rfc3339(),
                 "proposal_block_hash": _hexu(rs.proposal_block.hash()) if rs.proposal_block else "",
                 "locked_block_hash": _hexu(rs.locked_block.hash()) if rs.locked_block else "",
+                "locked_round": rs.locked_round,
                 "valid_block_hash": _hexu(rs.valid_block.hash()) if rs.valid_block else "",
+                "valid_round": rs.valid_round,
+                "height_vote_set": votes,
                 "validators": {
                     "validators": [_validator_json(v) for v in rs.validators.validators]
                     if rs.validators
                     else [],
                 },
             },
-            "peers": [],
+            "peers": peers,
         }
 
     def consensus_state():

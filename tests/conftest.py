@@ -38,3 +38,9 @@ def pytest_configure(config):
         "chaos: deterministic fault-injection tests (seeded CMTPU_FAULTS, "
         "CPU-only) for the verification-backend supervisor; runs in tier-1",
     )
+    config.addinivalue_line(
+        "markers",
+        "liveness: fast consensus-liveness tests (round-catchup gossip, "
+        "stall watchdog, restart-under-load with sub-second timeouts); "
+        "runs in tier-1 — `-m liveness` selects just this group",
+    )

@@ -5,6 +5,7 @@ votes become DuplicateVoteEvidence through the consensus reporting path
 (state.go tryAddVote -> evpool.ReportConflictingVotes), and the evidence
 lands in a committed block."""
 
+import queue
 import time
 from dataclasses import replace
 
@@ -16,6 +17,7 @@ from cometbft_tpu.config import test_config as make_test_config
 from cometbft_tpu.consensus import messages as cmsg
 from cometbft_tpu.node.node import Node
 from cometbft_tpu.types import BlockID, Vote, cmttime
+from cometbft_tpu.types import events as tev
 from cometbft_tpu.types.block import PREVOTE_TYPE
 from cometbft_tpu.types.evidence import DuplicateVoteEvidence
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
@@ -34,6 +36,18 @@ def _make_net(pvs, gen):
         cfg.rpc.laddr = ""
         cfg.consensus.timeout_commit = 0.15
         cfg.consensus.skip_timeout_commit = False
+        # test_config's 2ms/round escalation assumes instant delivery; this
+        # mesh pays real TCP gossip latency, and the byzantine vote churn
+        # adds round skew — the propose window must eventually outgrow
+        # proposal creation + transit or the chain spirals in no-block nil
+        # prevotes (the production defaults escalate by 0.5s/round for the
+        # same reason).
+        cfg.consensus.timeout_propose = 0.5
+        cfg.consensus.timeout_propose_delta = 0.25
+        cfg.consensus.timeout_prevote = 0.1
+        cfg.consensus.timeout_prevote_delta = 0.1
+        cfg.consensus.timeout_precommit = 0.1
+        cfg.consensus.timeout_precommit_delta = 0.1
         return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
 
     return [make(pv) for pv in pvs]
@@ -135,6 +149,18 @@ def test_prevote_equivocation_lands_in_committed_block():
         cfg.rpc.laddr = ""
         cfg.consensus.timeout_commit = 0.15
         cfg.consensus.skip_timeout_commit = False
+        # test_config's 2ms/round escalation assumes instant delivery; this
+        # mesh pays real TCP gossip latency, and the byzantine vote churn
+        # adds round skew — the propose window must eventually outgrow
+        # proposal creation + transit or the chain spirals in no-block nil
+        # prevotes (the production defaults escalate by 0.5s/round for the
+        # same reason).
+        cfg.consensus.timeout_propose = 0.5
+        cfg.consensus.timeout_propose_delta = 0.25
+        cfg.consensus.timeout_prevote = 0.1
+        cfg.consensus.timeout_prevote_delta = 0.1
+        cfg.consensus.timeout_precommit = 0.1
+        cfg.consensus.timeout_precommit_delta = 0.1
         return Node(cfg, gen, pv, LocalClientCreator(KVStoreApplication()))
 
     nodes = [make(pv) for pv in pvs]
@@ -146,16 +172,26 @@ def test_prevote_equivocation_lands_in_committed_block():
                 if j > i:
                     n.switch.dial_peer(f"{m.node_key.id}@{m.p2p_laddr}")
         cs0 = nodes[0].consensus_state
-        deadline = time.time() + 60
-        while time.time() < deadline and cs0.rs.height < 2:
-            time.sleep(0.05)
-        assert cs0.rs.height >= 2, "net never started committing"
+        assert cs0.wait_for_height(2, timeout=60), "net never started committing"
 
         # Validator 3 equivocates: two signed prevotes for DIFFERENT fake
-        # blocks at its current height/round, broadcast over the real vote
-        # channel (byzantine_test.go's prevote branch).
+        # blocks, broadcast over the real vote channel (byzantine_test.go's
+        # prevote branch). Instead of sampling rs.height/rs.round between
+        # sleeps — which races the state machine and can sign for a round the
+        # peers already left (or haven't entered) — subscribe to the
+        # byzantine node's NewRoundStep events and equivocate at the exact
+        # (height, round) of each step transition: the prevote/precommit-step
+        # firings land while every peer is provably inside that round.
         byz_node, byz_pv = nodes[3], pvs[3]
         byz_addr = byz_pv.address()
+        rounds = byz_node.event_bus.subscribe(
+            "byz-test", tev.query_for_event(tev.EVENT_NEW_ROUND_STEP)
+        )
+        # Committed blocks arrive as events too; checking each as it commits
+        # replaces the store-rescan polling loop.
+        blocks = nodes[0].event_bus.subscribe(
+            "byz-test", tev.query_for_event(tev.EVENT_NEW_BLOCK)
+        )
 
         def byz_index(height):
             vals = byz_node.consensus_state.state.validators
@@ -164,9 +200,7 @@ def test_prevote_equivocation_lands_in_committed_block():
                     return idx
             raise AssertionError("byzantine validator not in set")
 
-        def equivocate_once():
-            rs = byz_node.consensus_state.rs
-            h, r = rs.height, rs.round
+        def equivocate_at(h, r):
             idx = byz_index(h)
             now = cmttime.now()
             for mark in (b"\xaa", b"\xbb"):
@@ -181,37 +215,39 @@ def test_prevote_equivocation_lands_in_committed_block():
                     cmsg.VoteMessage(signed)
                 )
 
-        def committed_duplicate_vote_evidence():
-            for n in nodes[:3]:
-                store = n.block_store
-                for h in range(1, store.height() + 1):
-                    block = store.load_block(h)
-                    if block is None:
-                        continue
-                    for ev in block.evidence:
-                        if isinstance(ev, DuplicateVoteEvidence) and (
-                            ev.vote_a.validator_address == byz_addr
-                        ):
-                            return h, ev
+        def duplicate_vote_evidence(block):
+            for ev in block.evidence:
+                if isinstance(ev, DuplicateVoteEvidence) and (
+                    ev.vote_a.validator_address == byz_addr
+                ):
+                    return ev
             return None
 
         found = None
         deadline = time.time() + 90
         while time.time() < deadline and found is None:
-            equivocate_once()
-            time.sleep(0.3)
-            found = committed_duplicate_vote_evidence()
+            try:
+                msg = rounds.out.get(timeout=0.5)
+                equivocate_at(msg.data.height, msg.data.round)
+            except queue.Empty:
+                pass
+            while found is None:
+                try:
+                    bmsg = blocks.out.get_nowait()
+                except queue.Empty:
+                    break
+                found = duplicate_vote_evidence(bmsg.data.block)
         assert found is not None, "duplicate-vote evidence never committed"
-        ev_height, ev = found
-        assert ev.vote_a.block_id != ev.vote_b.block_id
-        assert ev.vote_a.height == ev.vote_b.height
+        assert found.vote_a.block_id != found.vote_b.block_id
+        assert found.vote_a.height == found.vote_b.height
+        byz_node.event_bus.unsubscribe_all("byz-test")
+        nodes[0].event_bus.unsubscribe_all("byz-test")
 
         # The honest majority keeps committing after the attack.
         target = cs0.rs.height + 2
-        deadline = time.time() + 60
-        while time.time() < deadline and cs0.rs.height < target:
-            time.sleep(0.05)
-        assert cs0.rs.height >= target, "chain halted after equivocation"
+        assert cs0.wait_for_height(target, timeout=60), (
+            "chain halted after equivocation"
+        )
     finally:
         for n in nodes:
             n.stop()

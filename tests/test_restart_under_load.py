@@ -1,0 +1,519 @@
+"""Restart-under-load recovery: a seeded multi-node TCP net where one node is
+crashed mid-round (SIGKILL semantics: buffered WAL frames are abandoned, only
+fsynced own messages survive) and restarted. The restarted node must replay
+its WAL back to the round it had reached, the round-catchup gossip cascade
+must feed it the votes for ITS round, and the whole net must re-converge
+within a bounded number of rounds.
+
+Two victim profiles:
+  * the quorum-critical validator (powers [10,10,10,16]: the survivors hold
+    30/46 < 2/3, so NOTHING commits until the victim rejoins — the exact
+    round-livelock the catchup cascade exists to break), and
+  * whoever is the current proposer at kill time (survivors keep committing;
+    the victim must catch up in height AND round under load).
+
+Also unit-level: WAL round restore from own fsynced votes, and the stall
+watchdog firing + metric on a quorumless node.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.consensus.cstypes import STEP_PREVOTE, STEP_PROPOSE
+from cometbft_tpu.consensus.messages import TimeoutInfo, VoteMessage
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.replay import Handshaker
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool.reactor import MempoolReactor
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport
+from cometbft_tpu.privval.file import FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import PREVOTE_TYPE
+
+pytestmark = pytest.mark.liveness
+
+CHAIN_ID = "restart-chain"
+# Node 3 is quorum-critical: without its 16, the rest hold 30/46 < 2/3.
+POWERS = [10, 10, 10, 16]
+MAX_ROUNDS_AFTER_RECOVERY = 12
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, v=1):
+        self.n += v
+
+
+class _Gauge:
+    def __init__(self):
+        self.v = None
+
+    def set(self, v):
+        self.v = v
+
+
+class _Net:
+    """4 validators over real TCP, each with file-backed FilePV + WAL and
+    MemDB stores that persist across in-process restarts."""
+
+    def __init__(self, tmp_path, powers=POWERS):
+        self.tmp = tmp_path
+        n = len(powers)
+        self.pvs = [
+            FilePV.load_or_generate(
+                str(tmp_path / f"pv{i}_key.json"), str(tmp_path / f"pv{i}_state.json")
+            )
+            for i in range(n)
+        ]
+        self.gen = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=Time(1700000000, 0),
+            validators=[
+                GenesisValidator(
+                    pv.get_pub_key().address(), pv.get_pub_key(), powers[i], f"v{i}"
+                )
+                for i, pv in enumerate(self.pvs)
+            ],
+        )
+        self.gen.validate_and_complete()
+        self.state_dbs = [MemDB() for _ in range(n)]
+        self.block_dbs = [MemDB() for _ in range(n)]
+        self.nodes: list = [None] * n
+        self.addrs: list = [None] * n
+        # Crashed bundles are kept referenced so GC can never finalize (and
+        # thereby flush) their abandoned WAL buffers — a real SIGKILL loses
+        # those frames, so must we.
+        self.dead: list = []
+
+    def _build(self, i):
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        conns.start()
+        cfg = make_test_config()
+        # test_config's deltas (2ms/round) assume in-process instant delivery.
+        # Post-restart this mesh has real TCP gossip latency plus round-entry
+        # skew, so rounds must escalate fast enough for the propose window to
+        # eventually cover proposal creation + transit — the same reason the
+        # production defaults use 0.5s deltas.
+        cfg.consensus.timeout_propose = 0.5
+        cfg.consensus.timeout_propose_delta = 0.25
+        cfg.consensus.timeout_prevote = 0.1
+        cfg.consensus.timeout_prevote_delta = 0.1
+        cfg.consensus.timeout_precommit = 0.1
+        cfg.consensus.timeout_precommit_delta = 0.1
+        mempool = CListMempool(cfg.mempool, conns.mempool)
+        state_store = StateStore(self.state_dbs[i])
+        block_store = BlockStore(self.block_dbs[i])
+        state = state_store.load()
+        if state is None:
+            state = make_genesis_state(self.gen)
+            state_store.save(state)
+        # The app restarts empty; the handshake replays committed blocks into
+        # it so its hash matches the persisted state (node.py does the same).
+        state = Handshaker(state_store, state, block_store, self.gen).handshake(conns)
+        executor = BlockExecutor(state_store, conns.consensus, mempool, None, block_store)
+        wal = WAL(str(self.tmp / f"wal{i}"))
+        cs = ConsensusState(
+            cfg.consensus, state, executor, block_store, mempool, wal=wal, name=f"n{i}"
+        )
+        cs.set_priv_validator(self.pvs[i])
+        nk = NodeKey()
+        ni = NodeInfo(node_id=nk.id, network=CHAIN_ID, moniker=f"n{i}")
+        sw = Switch(ni, MultiplexTransport(ni, nk))
+        reactor = ConsensusReactor(cs, gossip_sleep=0.005)
+        sw.add_reactor("CONSENSUS", reactor)
+        sw.add_reactor("MEMPOOL", MempoolReactor(cfg.mempool, mempool))
+        return {
+            "cs": cs,
+            "sw": sw,
+            "nk": nk,
+            "mp": mempool,
+            "reactor": reactor,
+            "wal": wal,
+        }
+
+    def start_all(self):
+        for i in range(len(self.nodes)):
+            node = self._build(i)
+            self.nodes[i] = node
+            addr = node["sw"].start("127.0.0.1:0")
+            self.addrs[i] = f"{node['nk'].id}@{addr}"
+        for i, node in enumerate(self.nodes):
+            for j in range(i + 1, len(self.nodes)):
+                node["sw"].dial_peer(self.addrs[j])
+        time.sleep(0.2)
+        for node in self.nodes:
+            node["cs"].start()
+
+    def crash(self, i):
+        """SIGKILL in-process: tear down sockets/threads and abandon the WAL
+        handle WITHOUT close/flush — only write_sync'd frames survive."""
+        node = self.nodes[i]
+        node["sw"].stop()
+        node["reactor"].stop()
+        node["cs"]._running = False
+        node["cs"].ticker.stop()
+        node["wal"]._running = False
+        self.dead.append(node)
+        self.nodes[i] = None
+
+    def restart(self, i):
+        node = self._build(i)
+        self.nodes[i] = node
+        addr = node["sw"].start("127.0.0.1:0")
+        self.addrs[i] = f"{node['nk'].id}@{addr}"
+        for j, other in enumerate(self.nodes):
+            if j != i and other is not None:
+                node["sw"].dial_peer(self.addrs[j])
+        time.sleep(0.1)
+        node["cs"].start()
+        return node
+
+    def stop_all(self):
+        for node in self.nodes:
+            if node is not None:
+                node["cs"].stop()
+                node["sw"].stop()
+
+    def heights(self):
+        return [n["cs"].rs.height if n is not None else 0 for n in self.nodes]
+
+    def wait_all_height(self, h, timeout):
+        deadline = time.monotonic() + timeout
+        for n in self.nodes:
+            if n is None:
+                continue
+            remaining = max(0.1, deadline - time.monotonic())
+            if not n["cs"].wait_for_height(h, timeout=remaining):
+                return False
+        return True
+
+    def diag(self):
+        parts = []
+        for k, n in enumerate(self.nodes):
+            if n is None:
+                parts.append(f"n{k}: dead")
+                continue
+            rs = n["cs"].rs
+            parts.append(
+                f"n{k}: h={rs.height} r={rs.round} step={rs.step} "
+                f"peers={n['sw'].num_peers()}"
+            )
+        return " | ".join(parts)
+
+
+def _pump_load(net, stop, rnd):
+    """Keep the mempools non-empty so restarts happen under real load.
+
+    Capped per node: an uncapped pump grows the mempool without bound while
+    the network is re-converging (nothing commits), and proposal-creation
+    latency grows with it — turning a liveness test into an unbounded
+    perf spiral. Real deployments cap the mempool too.
+    """
+    n = 0
+    while not stop.is_set():
+        live = [node for node in net.nodes if node is not None]
+        if live:
+            node = rnd.choice(live)
+            try:
+                if node["mp"].size() < 150:
+                    node["mp"].check_tx(f"load{n}={rnd.randrange(1 << 30)}".encode())
+            except Exception:
+                pass
+            n += 1
+        time.sleep(0.02)
+
+
+def _victim_quorum_critical(net, rnd):
+    return len(net.pvs) - 1  # power 16: survivors cannot commit without it
+
+
+def _victim_proposer(net, rnd):
+    """Whoever proposes the round in progress at kill time."""
+    live = next(n for n in net.nodes if n is not None)
+    prop = live["cs"].rs.validators.get_proposer()
+    for i, pv in enumerate(net.pvs):
+        if pv.get_pub_key().address() == prop.address:
+            return i
+    return 0
+
+
+def _run_restart_scenario(tmp_path, seed, pick_victim):
+    rnd = random.Random(seed)
+    net = _Net(tmp_path)
+    stop = threading.Event()
+    try:
+        net.start_all()
+        # The pump gets its own RNG: sharing `rnd` with the main thread's
+        # sleeps would make the kill/restart instants depend on pump timing,
+        # destroying seed reproducibility.
+        threading.Thread(
+            target=_pump_load, args=(net, stop, random.Random(seed + 1000)), daemon=True
+        ).start()
+        assert net.wait_all_height(2, timeout=45), f"no initial progress: {net.diag()}"
+        # Seeded mid-round kill instant.
+        time.sleep(rnd.uniform(0.0, 0.25))
+        victim = pick_victim(net, rnd)
+        h_kill = net.nodes[victim]["cs"].rs.height
+        net.crash(victim)
+        # Let the survivors run (or stall, if the victim was quorum-critical)
+        # for a seeded window before the restart.
+        time.sleep(rnd.uniform(0.05, 0.4))
+        net.restart(victim)
+        target = max(net.heights()) + 2
+        ok = net.wait_all_height(target, timeout=60)
+        assert ok, (
+            f"no re-convergence after restarting n{victim} "
+            f"(killed at h={h_kill}, target h={target}): {net.diag()}"
+        )
+        for n in net.nodes:
+            assert n["cs"].rs.round <= MAX_ROUNDS_AFTER_RECOVERY, (
+                f"round runaway after recovery: {net.diag()}"
+            )
+        # Everyone agrees on the last fully-committed block.
+        h_check = target - 1
+        hashes = {n["cs"].block_store.load_block(h_check).hash() for n in net.nodes}
+        assert len(hashes) == 1, f"hash divergence at h={h_check}"
+    finally:
+        stop.set()
+        net.stop_all()
+
+
+def test_restart_quorum_critical_node_reconverges(tmp_path):
+    """Kill the validator without which nothing commits: the survivors stall
+    mid-round, and the restarted node must be gossip-fed back to quorum."""
+    _run_restart_scenario(tmp_path, seed=1, pick_victim=_victim_quorum_critical)
+
+
+def test_restart_proposer_reconverges(tmp_path):
+    """Kill the current proposer under load; the rest keep committing and the
+    restarted node must catch up in height and round."""
+    _run_restart_scenario(tmp_path, seed=2, pick_victim=_victim_proposer)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(1, 11))
+def test_restart_under_load_seed_sweep(tmp_path, seed):
+    """Acceptance sweep: 10/10 seeded runs must re-converge, alternating the
+    quorum-critical and proposer victim profiles."""
+    pick = _victim_quorum_critical if seed % 2 else _victim_proposer
+    _run_restart_scenario(tmp_path, seed=seed, pick_victim=pick)
+
+
+# -- unit level: WAL round restore + stall watchdog ---------------------------
+
+
+def _solo_node(gen, pv, wal=None, cfg=None):
+    state = make_genesis_state(gen)
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    cfg = cfg or make_test_config()
+    mempool = CListMempool(cfg.mempool, conns.mempool)
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state_store.save(state)
+    executor = BlockExecutor(state_store, conns.consensus, mempool, None, block_store)
+    cs = ConsensusState(
+        cfg.consensus, state, executor, block_store, mempool, wal=wal, name="solo"
+    )
+    cs.set_priv_validator(pv)
+    return cs, state
+
+
+def _mock_genesis(n, chain_id=CHAIN_ID):
+    pvs = [MockPV() for _ in range(n)]
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+    return pvs, gen
+
+
+def test_wal_replay_restores_round(tmp_path):
+    """A WAL holding our own fsynced prevotes for rounds 0..2 (plus a ticker
+    timeout) must restart the node AT round 2, not round 0."""
+    pvs, gen = _mock_genesis(4)
+    wal_path = str(tmp_path / "wal")
+    wal = WAL(wal_path)
+    wal.start()  # writes the EndHeight(0) replay anchor
+    state = make_genesis_state(gen)
+    idx, _ = state.validators.get_by_address(pvs[0].address())
+    for r in range(3):
+        vote = Vote(
+            type=PREVOTE_TYPE,
+            height=1,
+            round=r,
+            block_id=BlockID(),
+            timestamp=Time(1700000001, 0),
+            validator_address=pvs[0].address(),
+            validator_index=idx,
+        )
+        wal.write_sync(VoteMessage(pvs[0].sign_vote(CHAIN_ID, vote)))
+    wal.write_sync(TimeoutInfo(0.4, 1, 2, STEP_PROPOSE))
+    wal.stop()
+
+    cs, _state = _solo_node(gen, pvs[0], wal=WAL(wal_path))
+    gauge = _Gauge()
+    cs.metrics.wal_replay_round = gauge
+    cs.start()
+    try:
+        assert cs.rs.height == 1
+        assert cs.rs.round == 2, f"round not restored: r={cs.rs.round}"
+        # Our own recorded prevote at the restored round re-enters PREVOTE.
+        assert cs.rs.step >= STEP_PREVOTE, f"step not restored: {cs.rs.step}"
+        assert gauge.v == 2
+        # The replayed votes are back in the height vote set.
+        own = cs.rs.votes.prevotes(2).get_by_address(pvs[0].address())
+        assert own is not None
+    finally:
+        cs.stop()
+
+
+def test_wal_replay_ignores_peer_votes_for_round_restore(tmp_path):
+    """A (buffered-write) peer vote at an absurd round must NOT drag the
+    restored round forward — only our own fsynced votes count."""
+    pvs, gen = _mock_genesis(4)
+    wal_path = str(tmp_path / "wal")
+    wal = WAL(wal_path)
+    wal.start()
+    state = make_genesis_state(gen)
+    idx, _ = state.validators.get_by_address(pvs[1].address())
+    peer_vote = Vote(
+        type=PREVOTE_TYPE,
+        height=1,
+        round=1000,
+        block_id=BlockID(),
+        timestamp=Time(1700000001, 0),
+        validator_address=pvs[1].address(),
+        validator_index=idx,
+    )
+    wal.write_sync(VoteMessage(pvs[1].sign_vote(CHAIN_ID, peer_vote)))
+    wal.stop()
+
+    cs, _state = _solo_node(gen, pvs[0], wal=WAL(wal_path))
+    cs.start()
+    try:
+        assert cs.rs.round == 0, f"peer vote dragged the round to {cs.rs.round}"
+    finally:
+        cs.stop()
+
+
+def _file_pv_genesis(tmp_path, n):
+    """Genesis whose validator 0 is a FilePV (real persisted sign state)."""
+    pv0 = FilePV.load_or_generate(
+        str(tmp_path / "solo_key.json"), str(tmp_path / "solo_state.json")
+    )
+    pvs = [pv0] + [MockPV() for _ in range(n - 1)]
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gen.validate_and_complete()
+    return pvs, gen
+
+
+@pytest.mark.parametrize("lost_round", [0, 2])
+def test_privval_vote_recovered_when_wal_lost_it(tmp_path, lost_round):
+    """Crash window between the privval fsync and the WAL write: the privval
+    remembers signing a prevote the WAL never recorded. On restart the
+    double-sign guard would (correctly) refuse to vote at that (h, r) ever
+    again — so the node must reconstruct the vote from the persisted
+    sign_bytes + signature and re-publish it, or a quorum-critical restart
+    livelocks the whole network at that round."""
+    pvs, gen = _file_pv_genesis(tmp_path, 4)
+    state = make_genesis_state(gen)
+    idx, _ = state.validators.get_by_address(pvs[0].get_pub_key().address())
+
+    wal_path = str(tmp_path / "wal")
+    wal = WAL(wal_path)
+    wal.start()  # EndHeight(0) anchor only — the vote below never lands here
+    wal.stop()
+
+    vote = Vote(
+        type=PREVOTE_TYPE,
+        height=1,
+        round=lost_round,
+        block_id=BlockID(),
+        timestamp=Time(1700000001, 0),
+        validator_address=pvs[0].get_pub_key().address(),
+        validator_index=idx,
+    )
+    signed = pvs[0].sign_vote(CHAIN_ID, vote)  # privval persists; WAL doesn't
+
+    # "Restart": fresh FilePV over the same files, fresh ConsensusState.
+    pv_restarted = FilePV.load_or_generate(
+        str(tmp_path / "solo_key.json"), str(tmp_path / "solo_state.json")
+    )
+    cs, _state = _solo_node(gen, pv_restarted, wal=WAL(wal_path))
+    cs.start()
+    try:
+        assert cs.rs.round == lost_round, (
+            f"privval sign state did not restore the round: r={cs.rs.round}"
+        )
+        own_addr = pvs[0].get_pub_key().address()
+        deadline = time.monotonic() + 5.0
+        own = None
+        while time.monotonic() < deadline:
+            pv_set = cs.rs.votes.prevotes(lost_round)
+            own = pv_set.get_by_address(own_addr) if pv_set is not None else None
+            if own is not None:
+                break
+            time.sleep(0.05)
+        assert own is not None, "lost vote was not recovered into the vote set"
+        assert own.signature == signed.signature
+        assert cs.rs.step >= STEP_PREVOTE, f"step not restored: {cs.rs.step}"
+    finally:
+        cs.stop()
+
+
+def test_stall_watchdog_fires_and_counts():
+    """A quorumless node (1 of 2 validators running) wedges in PREVOTE with no
+    pending timer; the watchdog must fire the on_stall hook and bump the
+    stall counter within a few budgets."""
+    pvs, gen = _mock_genesis(2, chain_id="stall-chain")
+    cfg = make_test_config()
+    cfg.consensus.stall_watchdog_factor = 0.5
+    cs, _state = _solo_node(gen, pvs[0], cfg=cfg)
+    stalled = threading.Event()
+    cs.set_on_stall(stalled.set)
+    counter = _Counter()
+    cs.metrics.consensus_stalls_total = counter
+    cs.start()
+    try:
+        assert stalled.wait(10.0), "watchdog never fired on a wedged node"
+        assert counter.n >= 1
+    finally:
+        cs.stop()
+
+
+def test_stall_watchdog_env_override(monkeypatch):
+    """CMTPU_STALL_FACTOR=0 disables the watchdog regardless of config."""
+    monkeypatch.setenv("CMTPU_STALL_FACTOR", "0")
+    pvs, gen = _mock_genesis(2, chain_id="stall-chain")
+    cs, _state = _solo_node(gen, pvs[0])
+    assert cs._stall_factor == 0.0
