@@ -767,6 +767,157 @@ def _coalesce_stage(stages: dict, plog) -> None:
         _be.set_backend(old_backend)
 
 
+def _ingress_stage(stages: dict, plog) -> None:
+    """QoS ingress admission (ISSUE 5): K concurrent senders flood signed
+    envelopes; serialized per-tx verification admission (the pre-ingress
+    world — every tx pays its own backend dispatch) vs the ingress
+    pipeline's micro-batched pre-verification through the coalescing
+    scheduler.  Same convention as the coalesce stage: both arms run the
+    same host-MSM backend wrapped with a fixed per-dispatch latency
+    (CMTPU_BENCH_INGRESS_DISPATCH_MS, default 5 — deliberately far below
+    the coalesce stage's 50 ms tunnel cost, because the serialized arm
+    pays it K*TXS times and the stage must stay inside the bench budget;
+    the JSON labels it)."""
+    import threading as _threading
+
+    from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+    from cometbft_tpu.config.config import MempoolConfig
+    from cometbft_tpu.crypto import ed25519 as _ed
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.mempool.ingress import IngressPipeline, decode_envelope, encode_envelope
+    from cometbft_tpu.proxy import LocalClientCreator
+    from cometbft_tpu.sidecar import backend as _be
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+
+    k = int(os.environ.get("CMTPU_BENCH_INGRESS_SENDERS", "8"))
+    per = int(os.environ.get("CMTPU_BENCH_INGRESS_TXS", "512"))
+    dispatch_ms = float(os.environ.get("CMTPU_BENCH_INGRESS_DISPATCH_MS", "5"))
+    total = k * per
+
+    privs = [_ed.gen_priv_key_from_secret(b"ing-%d" % i) for i in range(k)]
+    floods = [
+        [
+            encode_envelope(privs[i], b"ing/%d/%d=v" % (i, j), priority=i % 4, nonce=j)
+            for j in range(per)
+        ]
+        for i in range(k)
+    ]
+    plog(f"ingress fixture built ({k} senders x {per} envelopes)")
+
+    class _DispatchLatency:
+        name = "latency"
+
+        def __init__(self):
+            self._cpu = CpuBackend()
+            self.calls = 0
+
+        def batch_verify(self, pubs, msgs, sigs_):
+            self.calls += 1
+            if dispatch_ms > 0:
+                time.sleep(dispatch_ms / 1000.0)
+            return self._cpu.batch_verify(pubs, msgs, sigs_)
+
+        def merkle_root(self, leaves):
+            return self._cpu.merkle_root(leaves)
+
+    def _fresh_mempool():
+        app = KVStoreApplication()
+        cli = LocalClientCreator(app).new_abci_client()
+        return CListMempool(MempoolConfig(size=total * 2, cache_size=total * 2), cli)
+
+    old_backend = _be._backend
+    try:
+        # -- serialized: each tx verified with its own dispatch, then admitted --
+        lat = _DispatchLatency()
+        _be.set_backend(lat)
+        _ed._verified.clear()
+        mp1 = _fresh_mempool()
+        start = _threading.Barrier(k + 1)
+
+        def _serial_sender(i):
+            start.wait()
+            for tx in floods[i]:
+                env = decode_envelope(tx)
+                ok, bits = _be.get_backend().batch_verify(
+                    [env.pubkey], [env.sign_bytes()], [env.signature]
+                )
+                if bits[0]:
+                    mp1.check_tx(tx, sender=env.sender)
+
+        threads = [_threading.Thread(target=_serial_sender, args=(i,)) for i in range(k)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(600.0)
+        serialized_ms = (time.perf_counter() - t0) * 1000
+        assert lat.calls == total and mp1.size() == total
+
+        # -- batched: the ingress pipeline's micro-batched preverify --
+        lat2 = _DispatchLatency()
+        sched = CoalescingScheduler(lat2, window_ms=2.0)
+        _be.set_backend(sched)
+        _ed._verified.clear()
+        mp2 = _fresh_mempool()
+        ing = IngressPipeline(
+            MempoolConfig(
+                size=total * 2,
+                cache_size=total * 2,
+                ingress_queue_max=total,
+                ingress_window_ms=2.0,
+            ),
+            mp2,
+        )
+        start2 = _threading.Barrier(k + 1)
+
+        def _ingress_sender(i):
+            start2.wait()
+            for tx in floods[i]:
+                ing.check_tx(tx)
+
+        threads = [_threading.Thread(target=_ingress_sender, args=(i,)) for i in range(k)]
+        for t in threads:
+            t.start()
+        start2.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(600.0)
+        deadline = time.monotonic() + 120.0
+        while mp2.size() < total and time.monotonic() < deadline:
+            time.sleep(0.002)
+        batched_ms = (time.perf_counter() - t0) * 1000
+        st = ing.stats()
+        ing.close()
+        sched.close()
+        if mp2.size() != total:
+            raise RuntimeError(f"ingress arm admitted {mp2.size()}/{total}")
+        stages["ingress"] = {
+            "senders": k,
+            "txs_per_sender": per,
+            "simulated_dispatch_ms": dispatch_ms,
+            "serialized_ms": round(serialized_ms, 2),
+            "batched_ms": round(batched_ms, 2),
+            "speedup": round(serialized_ms / max(batched_ms, 1e-9), 2),
+            "serialized_dispatches": lat.calls,
+            "batched_dispatches": lat2.calls,
+            "preverify_batches": st["preverify_batches"],
+            "preverify_batch_max": st["preverify_batch_max"],
+            "admitted": st["admitted"],
+            "shed_total": st["shed_total"],
+        }
+        plog(
+            f"ingress: {k}x{per} serialized {serialized_ms:.0f} ms "
+            f"-> batched {batched_ms:.0f} ms "
+            f"({stages['ingress']['speedup']}x, {lat2.calls} dispatches, "
+            f"max preverify batch {st['preverify_batch_max']})"
+        )
+    finally:
+        _ed._verified.clear()
+        _be.set_backend(old_backend)
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -836,6 +987,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _coalesce_stage(stages, plog)
         except Exception as e:
             plog(f"coalesce stage failed: {type(e).__name__}: {e}")
+
+    # ---- QoS ingress: batched preverify admission vs per-tx dispatch ----
+    if budget_left():
+        try:
+            _ingress_stage(stages, plog)
+        except Exception as e:
+            plog(f"ingress stage failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":

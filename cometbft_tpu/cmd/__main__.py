@@ -539,6 +539,7 @@ def cmd_loadtime(args) -> int:
         rate=args.rate,
         min_blocks=args.blocks,
         connections=args.connections,
+        signed=args.signed,
         log=lambda s: print(s, file=sys.stderr),
     )
     print(rep.to_json())
@@ -672,6 +673,8 @@ def main(argv=None) -> int:
     sp.add_argument("--connections", type=int, default=1)
     sp.add_argument("--blocks", type=int, default=100)
     sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--signed", action="store_true",
+                    help="emit SignedTxEnvelopes through the QoS ingress")
     sp = sub.add_parser("e2e")
     # Flat flags keep `e2e --manifest m.toml` working; the nested
     # subcommands mirror the reference's runner/generator split.

@@ -115,6 +115,12 @@ class MempoolConfig:
     keep_invalid_txs_in_cache: bool = False
     max_tx_bytes: int = 1048576
     max_batch_bytes: int = 0
+    # QoS ingress (mempool/ingress.py); CMTPU_INGRESS_* env knobs override.
+    ingress_enable: bool = True
+    ingress_lanes: int = 4
+    ingress_sender_rps: float = 0.0  # 0 = per-sender rate limit off
+    ingress_queue_max: int = 2048
+    ingress_window_ms: float = 2.0
 
 
 @dataclass
@@ -244,6 +250,12 @@ class Config:
                 raise ValueError(f"consensus.{name} can't be negative")
         if self.mempool.size < 0:
             raise ValueError("mempool.size can't be negative")
+        if self.mempool.ingress_lanes < 1:
+            raise ValueError("mempool.ingress_lanes must be >= 1")
+        if self.mempool.ingress_sender_rps < 0:
+            raise ValueError("mempool.ingress_sender_rps can't be negative")
+        if self.mempool.ingress_queue_max < 1:
+            raise ValueError("mempool.ingress_queue_max must be >= 1")
 
 
 def default_config() -> Config:

@@ -49,9 +49,9 @@ _ABCI_FULL = ("local",) * 5 + ("socket",) * 3 + ("grpc",) * 2
 _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
 _PERTURB_FULL = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
-    "concurrent_light_clients",
+    "concurrent_light_clients", "tx_flood",
 )
-_PERTURB_SMALL = ("pause", "restart", "backend_faults")
+_PERTURB_SMALL = ("pause", "restart", "backend_faults", "tx_flood")
 
 
 def generate(seed: int, profile: str = "full") -> str:

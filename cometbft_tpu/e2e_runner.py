@@ -61,7 +61,7 @@ MODES = ("validator", "full", "seed")
 ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
-    "concurrent_light_clients",
+    "concurrent_light_clients", "tx_flood",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -197,6 +197,10 @@ class E2ERunner:
         # Per-node results of the concurrent_light_clients perturbation
         # (swarm agreement + the runner-process coalesce counter deltas).
         self._light_swarms: dict[str, dict] = {}
+        # Nodes relaunched with per-sender ingress rate limiting armed, and
+        # the per-node results of the tx_flood perturbation.
+        self._flood_armed: set[str] = set()
+        self._tx_floods: dict[str, dict] = {}
         # Stall forensics: every node's consensus round-state, captured at
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
@@ -357,6 +361,13 @@ class E2ERunner:
         env = self._node_env()
         if node.name in self._fault_armed:
             env.update(self._fault_env(idx))
+        if node.name in self._flood_armed:
+            # tx_flood arms a finite per-sender admission rate so the
+            # hostile signer gets shed instead of squatting the mempool.
+            # The rate must sit well under what the spammer can push
+            # through one HTTP connection on a slow host (~20/s observed
+            # single-core) and well over the honest cadence (~1 tx/s).
+            env["CMTPU_INGRESS_SENDER_RPS"] = "4"
         return subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cmd", "--home",
              os.path.join(self.home, f"node{idx}"), "start"],
@@ -470,6 +481,20 @@ class E2ERunner:
             proc.send_signal(signal.SIGSTOP)
             time.sleep(3.0)
             proc.send_signal(signal.SIGCONT)
+        elif kind == "tx_flood":
+            # Relaunch with per-sender rate limiting armed, wait for the
+            # node to rejoin, then run the flood: one hostile signer
+            # saturating admission while well-behaved signers keep
+            # submitting.  QoS holds if the honest txs still commit within
+            # bound and the spammer's excess is shed (counter delta).
+            self._flood_armed.add(name)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+            h0 = self.wait_height(self.manifest.nodes[0].name, 1)
+            self.wait_height(name, h0 + 1, timeout=420)
+            self._tx_floods[name] = self._tx_flood(node)
         elif kind == "concurrent_light_clients":
             # No process disruption: the stress IS the perturbation.  N
             # light clients bisect against this node simultaneously; their
@@ -683,6 +708,111 @@ class E2ERunner:
             out["coalesce"] = delta
         return out
 
+    def _tx_flood(
+        self,
+        node: ManifestNode,
+        duration_s: float = 6.0,
+        honest_senders: int = 3,
+        honest_rounds: int = 5,
+        commit_bound: int = 10,
+    ) -> dict:
+        """One hostile signer floods `node` with signed envelopes while
+        well-behaved signers submit at a civil rate (>= 10:1 offered-load
+        ratio).  Asserts QoS end to end: every honest tx is accepted by
+        admission AND committed within `commit_bound` blocks of the flood
+        start, while the spammer's excess is rate-limited/shed (non-zero
+        ingress shed counter delta on the flooded node)."""
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.mempool.ingress import encode_envelope
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        name = node.name
+        url = f"http://127.0.0.1:{self.rpc_ports[name]}"
+        cli = HTTPClient(url, timeout=5)
+        before = cli.call("ingress_stats")
+        if not before.get("enabled"):
+            raise AssertionError(f"{name}: ingress pipeline not enabled")
+        seed = max(self.manifest.seed, 0)
+        spammer = ed25519.gen_priv_key_from_secret(b"e2e-spam-%d" % seed)
+        honest = [
+            ed25519.gen_priv_key_from_secret(b"e2e-honest-%d-%d" % (seed, i))
+            for i in range(honest_senders)
+        ]
+        start_h = self._height(name)
+        stop = threading.Event()
+        spam_sent = [0]
+
+        def spam() -> None:
+            scli = HTTPClient(url, timeout=3)
+            k = 0
+            while not stop.is_set():
+                tx = encode_envelope(
+                    spammer, b"spam/%d/%d=x" % (seed, k), priority=2, nonce=k
+                )
+                try:
+                    scli.call("broadcast_tx_async", tx="0x" + tx.hex())
+                    spam_sent[0] += 1
+                except Exception:
+                    pass
+                k += 1
+                time.sleep(0.002)
+
+        spam_thread = threading.Thread(target=spam, daemon=True)
+        spam_thread.start()
+        honest_txs: list[bytes] = []
+        interval = duration_s / (honest_rounds + 1)
+        for j in range(honest_rounds):
+            time.sleep(interval)
+            for i, priv in enumerate(honest):
+                tx = encode_envelope(
+                    priv, b"honest/%d/%d/%d=x" % (seed, i, j), priority=3, nonce=j
+                )
+                res = cli.call("broadcast_tx_sync", tx="0x" + tx.hex())
+                if int(res.get("code", -1)) != 0:
+                    stop.set()
+                    raise AssertionError(
+                        f"{name}: honest tx rejected during flood: {res}"
+                    )
+                honest_txs.append(tx)
+        time.sleep(interval)
+        stop.set()
+        spam_thread.join(timeout=5)
+        after = cli.call("ingress_stats")
+        delta = {
+            k: after[k] - before.get(k, 0)
+            for k in after
+            if isinstance(after.get(k), int) and isinstance(before.get(k, 0), int)
+        }
+        if delta.get("shed_total", 0) <= 0:
+            raise AssertionError(
+                f"{name}: flood of {spam_sent[0]} spam txs was never shed: {delta}"
+            )
+        # Commit-within-bound: scan node 0's chain for every honest tx.
+        first = self.manifest.nodes[0].name
+        end_h = start_h + commit_bound
+        self.wait_height(first, end_h, timeout=420)
+        cli0 = HTTPClient(f"http://127.0.0.1:{self.rpc_ports[first]}", timeout=5)
+        want = {base64.b64encode(t).decode() for t in honest_txs}
+        seen: set[str] = set()
+        for h in range(start_h, end_h + 1):
+            blk = cli0.block(h)
+            if blk.get("block"):
+                seen.update(blk["block"]["data"]["txs"] or [])
+        missing = want - seen
+        if missing:
+            raise AssertionError(
+                f"{name}: {len(missing)}/{len(want)} honest txs not committed "
+                f"within {commit_bound} blocks of the flood"
+            )
+        return {
+            "spam_offered": spam_sent[0],
+            "honest_offered": len(honest_txs),
+            "honest_committed": len(want),
+            "commit_bound_blocks": commit_bound,
+            "ingress_delta": delta,
+            "lane_depths_after": after.get("lane_depths"),
+        }
+
     # -- the run ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -755,6 +885,8 @@ class E2ERunner:
                 report["backend_faults"] = sorted(self._fault_armed)
             if self._light_swarms:
                 report["concurrent_light_clients"] = self._light_swarms
+            if self._tx_floods:
+                report["tx_flood"] = self._tx_floods
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

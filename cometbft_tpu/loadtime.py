@@ -55,8 +55,30 @@ def make_payload(seq: int, now_ns: int, size: int = 64) -> bytes:
     return base + b"x" * max(0, size - len(base))
 
 
+def make_signed_payload(
+    priv, seq: int, now_ns: int, size: int = 64, priority: int = 0
+) -> bytes:
+    """A loadtime payload wrapped in a SignedTxEnvelope, so load generation
+    exercises the QoS ingress preverify path (mempool/ingress.py)."""
+    from cometbft_tpu.mempool.ingress import encode_envelope
+
+    return encode_envelope(
+        priv, make_payload(seq, now_ns, size), priority=priority, nonce=seq
+    )
+
+
 def parse_payload(tx: bytes) -> int | None:
-    """Creation time (ns) if this is a loadtime tx."""
+    """Creation time (ns) if this is a loadtime tx (enveloped or bare)."""
+    if tx and tx[0] == 0xCE:  # SignedTxEnvelope: latency lives in the payload
+        try:
+            from cometbft_tpu.mempool.ingress import decode_envelope
+
+            env = decode_envelope(tx)
+        except Exception:
+            return None
+        if env is None:
+            return None
+        tx = env.payload
     if not tx.startswith(b"load/"):
         return None
     try:
@@ -111,12 +133,16 @@ def run_load(
     min_blocks: int = 100,
     connections: int = 1,
     timeout_s: float = 120.0,
+    signed: bool = False,
     log=lambda s: None,
 ) -> Report:
     """Drive an in-process TCP devnet at `rate` tx/s (split over
     `connections` submitter threads, loadtime's `-c`) until `min_blocks`
     consecutive blocks have been produced under load; report over exactly
-    that window."""
+    that window.  With ``signed=True`` each connection signs its txs into
+    SignedTxEnvelopes and submits through the node's ingress pipeline, so
+    the run measures admission through batched signature pre-verification
+    rather than bare FIFO insertion."""
     if rate <= 0 or connections <= 0 or min_blocks <= 0:
         raise ValueError("rate, connections, and min_blocks must be positive")
     from cometbft_tpu.abci.client import LocalClientCreator
@@ -164,13 +190,24 @@ def run_load(
             # Each connection paces itself to rate/connections tx/s
             per = rate / connections
             next_t = time.monotonic()
+            sender_priv = (
+                ed25519.gen_priv_key_from_secret(b"load-sender-%d" % conn_idx)
+                if signed
+                else None
+            )
             while not stop.is_set():
                 with seq_lock:
                     k = seq[0]
                     seq[0] += 1
-                tx = make_payload(k, time.time_ns())
+                nd = nodes[conn_idx % n_vals]
+                if signed:
+                    tx = make_signed_payload(sender_priv, k, time.time_ns())
+                    target = nd.ingress or nd.mempool
+                else:
+                    tx = make_payload(k, time.time_ns())
+                    target = nd.mempool
                 try:
-                    nodes[conn_idx % n_vals].mempool.check_tx(tx)
+                    target.check_tx(tx)
                 except Exception:
                     pass
                 next_t += 1.0 / per

@@ -1,5 +1,15 @@
-"""Mempool (reference: mempool/, 1,607 LoC)."""
+"""Mempool (reference: mempool/, 1,607 LoC) + QoS ingress pipeline."""
 
 from cometbft_tpu.mempool.clist_mempool import CListMempool, TxCache
 
-__all__ = ["CListMempool", "TxCache"]
+__all__ = ["CListMempool", "TxCache", "IngressPipeline", "SignedTxEnvelope"]
+
+
+def __getattr__(name):
+    # Lazy: ingress pulls in crypto/backend modules; keep plain mempool
+    # imports cheap for consumers that never touch admission.
+    if name in ("IngressPipeline", "SignedTxEnvelope"):
+        from cometbft_tpu.mempool import ingress
+
+        return getattr(ingress, name)
+    raise AttributeError(name)

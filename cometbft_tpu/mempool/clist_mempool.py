@@ -24,6 +24,8 @@ class MempoolTx:
     gas_wanted: int
     tx: bytes
     senders: set
+    lane: int = 0  # QoS priority lane (higher drains first at reap)
+    seq: int = 0  # admission order, FIFO tiebreak within a lane
 
 
 class TxCache:
@@ -120,6 +122,7 @@ class CListMempool:
         self.recheck_txs: list[bytes] = []
         self._notified_available = threading.Event()
         self.tx_available_callback = None
+        self._admit_seq = 0
 
     # -- Mempool interface (mempool/mempool.go:32) ---------------------------
 
@@ -145,9 +148,10 @@ class CListMempool:
             self._txs_bytes = 0
             self.cache.reset()
 
-    def check_tx(self, tx: bytes, callback=None, sender: str = "") -> None:
+    def check_tx(self, tx: bytes, callback=None, sender: str = "", lane: int = 0) -> None:
         """clist_mempool.go:202-280 CheckTx: size/pre-check, cache dedup,
-        async app CheckTx, insertion via resCbFirstTime."""
+        async app CheckTx, insertion via resCbFirstTime. ``lane`` tags the
+        entry's QoS priority lane (0 = legacy/lowest) for lane-aware reap."""
         with self._mtx:
             tx_size = len(tx)
             if self.size() >= self.config.size or (
@@ -172,13 +176,15 @@ class CListMempool:
                 raise ErrTxInCache()
 
         def on_res(res: abci.ResponseCheckTx):
-            self._res_cb_first_time(tx, sender, res)
+            self._res_cb_first_time(tx, sender, res, lane=lane)
             if callback:
                 callback(res)
 
         self.proxy_app.check_tx_async(abci.RequestCheckTx(tx=tx), on_res)
 
-    def _res_cb_first_time(self, tx: bytes, sender: str, res: abci.ResponseCheckTx):
+    def _res_cb_first_time(
+        self, tx: bytes, sender: str, res: abci.ResponseCheckTx, lane: int = 0
+    ):
         post_ok = True
         if self.post_check:
             try:
@@ -197,11 +203,14 @@ class CListMempool:
                     return
                 k = tx_key(tx)
                 if k not in self._txs:
+                    self._admit_seq += 1
                     self._txs[k] = MempoolTx(
                         height=self.height,
                         gas_wanted=res.gas_wanted,
                         tx=tx,
                         senders={sender} if sender else set(),
+                        lane=lane,
+                        seq=self._admit_seq,
                     )
                     self._txs_bytes += len(tx)
             self._notify_tx_available()
@@ -221,12 +230,17 @@ class CListMempool:
             self.tx_available_callback()
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
-        """clist_mempool.go ReapMaxBytesMaxGas (FIFO, byte/gas-capped)."""
+        """Lane-aware ReapMaxBytesMaxGas: high-priority lanes drain first,
+        FIFO (admission seq) within a lane. With no ingress wired every tx
+        sits in lane 0 and this degenerates to the reference's pure FIFO."""
         with self._mtx:
             total_bytes = 0
             total_gas = 0
             out = []
-            for mtx in self._txs.values():
+            entries = list(self._txs.values())
+            if any(m.lane for m in entries):
+                entries.sort(key=lambda m: (-m.lane, m.seq))
+            for mtx in entries:
                 tx_len = len(mtx.tx) + 5  # amino/proto overhead bound
                 if max_bytes > -1 and total_bytes + tx_len > max_bytes:
                     break
@@ -267,11 +281,42 @@ class CListMempool:
             self._recheck_txs()
 
     def _recheck_txs(self) -> None:
-        """Re-run CheckTx(RECHECK) on survivors; drop newly-invalid ones."""
-        for k, entry in list(self._txs.items()):
-            res = self.proxy_app.check_tx(
-                abci.RequestCheckTx(tx=entry.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+        """Re-run CheckTx(RECHECK) on survivors; drop newly-invalid ones.
+
+        The survivor snapshot is taken under ``_mtx`` (concurrent admission
+        must not tear the iteration), and the rechecks go through the async
+        proxy as one pipelined wave closed by a single flush — N txs cost
+        one round trip to a socket/grpc app instead of N.
+        """
+        with self._mtx:
+            snapshot = list(self._txs.items())
+        if not snapshot:
+            return
+        results: list = [None] * len(snapshot)
+        pending = threading.Event()
+        remaining = [len(snapshot)]
+        rlock = threading.Lock()
+
+        def on_res(i: int):
+            def cb(res: abci.ResponseCheckTx):
+                results[i] = res
+                with rlock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        pending.set()
+
+            return cb
+
+        for i, (_, entry) in enumerate(snapshot):
+            self.proxy_app.check_tx_async(
+                abci.RequestCheckTx(tx=entry.tx, type=abci.CHECK_TX_TYPE_RECHECK),
+                on_res(i),
             )
+        self.proxy_app.flush()
+        pending.wait(timeout=10.0)
+        for (k, entry), res in zip(snapshot, results):
+            if res is None:  # transport died mid-wave; keep the tx
+                continue
             post_ok = True
             if self.post_check:
                 try:

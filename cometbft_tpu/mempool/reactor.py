@@ -3,6 +3,11 @@
 One broadcast thread per peer walks the mempool FIFO and forwards txs the
 peer hasn't seen from us (reactor.go:132 broadcastTxRoutine); received txs
 enter CheckTx with the sender recorded so they aren't echoed back.
+
+``mempool`` here is the admission surface: when the node wires the QoS
+ingress pipeline (mempool/ingress.py), gossiped txs flow through the same
+envelope-preverify/lane/shedding path as RPC submissions — one admission
+story regardless of where a tx came from.
 """
 
 from __future__ import annotations

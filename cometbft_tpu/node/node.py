@@ -129,6 +129,15 @@ class Node:
 
         # Mempool + evidence + executor (node/node.go:230-248).
         self.mempool = CListMempool(config.mempool, self.proxy_app.mempool)
+        # QoS ingress: admission pipeline (envelope preverify, lanes,
+        # rate limits, shedding) fronting the clist mempool. RPC and the
+        # gossip reactor submit through it; consensus/executor keep the
+        # raw mempool (reap/update are not admission).
+        self.ingress = None
+        if getattr(config.mempool, "ingress_enable", True):
+            from cometbft_tpu.mempool.ingress import IngressPipeline
+
+            self.ingress = IngressPipeline(config.mempool, self.mempool)
         self.evidence_pool = EvidencePool(
             new_db("evidence", config.base.db_backend, db_dir),
             self.state_store,
@@ -158,6 +167,8 @@ class Node:
             cs_metrics = CsMetrics(reg)
             reg.gauge_func("mempool", "size", "Txs in the mempool.",
                            lambda: self.mempool.size())
+            if self.ingress is not None:
+                self.ingress.register_metrics(reg)
             reg.gauge_func("p2p", "peers", "Connected peers.",
                            lambda: self.switch.num_peers() if self.switch else 0)
             reg.gauge_func("blockstore", "height", "Block store tip height.",
@@ -239,7 +250,11 @@ class Node:
                 self.consensus_state,
                 gossip_sleep=config.consensus.peer_gossip_sleep_duration,
             )
-            self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+            # Gossiped txs enter the same admission path as RPC submissions
+            # (preverify + lanes), with the peer id recorded as sender.
+            self.mempool_reactor = MempoolReactor(
+                config.mempool, self.ingress or self.mempool
+            )
             self.evidence_reactor = EvidenceReactor(self.evidence_pool)
             self.blocksync_reactor = BlocksyncReactor(
                 self.consensus_state.state,
@@ -429,7 +444,8 @@ class Node:
                 block_store=self.block_store,
                 consensus_state=self.consensus_state,
                 consensus_reactor=getattr(self, "consensus_reactor", None),
-                mempool=self.mempool,
+                mempool=self.ingress or self.mempool,
+                ingress=self.ingress,
                 evidence_pool=self.evidence_pool,
                 event_bus=self.event_bus,
                 genesis_doc=self.genesis_doc,
@@ -456,6 +472,8 @@ class Node:
 
     def stop(self) -> None:
         self.consensus_state.stop()
+        if self.ingress is not None:
+            self.ingress.close()
         if getattr(self, "pprof_server", None) is not None:
             self.pprof_server.stop()
         if getattr(self, "watchdog", None) is not None:

@@ -30,6 +30,7 @@ class Environment:
     consensus_state: object = None
     consensus_reactor: object = None  # peer round-state introspection
     mempool: object = None
+    ingress: object = None  # IngressPipeline when QoS admission is wired
     evidence_pool: object = None
     event_bus: EventBus | None = None
     genesis_doc: object = None
@@ -438,15 +439,26 @@ def routes(env: Environment) -> dict:
             done.set()
 
         env.mempool.check_tx(raw, callback=cb)
-        done.wait(5.0)
-        res = result.get("res")
+        # Same deadline source as broadcast_tx_commit (config/config.go
+        # TimeoutBroadcastTxCommit) instead of a hard-coded 5s.
+        timeout = (
+            env.config.rpc.timeout_broadcast_tx_commit if env.config else 10.0
+        )
+        if not done.wait(timeout):
+            raise RPCError(
+                -32603,
+                f"timed out waiting for tx to be included in the mempool "
+                f"(after {timeout}s)",
+                None,
+            )
+        res = result["res"]
         from cometbft_tpu.types.tx import tx_hash
 
         return {
-            "code": res.code if res else -1,
-            "data": _b64(res.data) if res else "",
-            "log": res.log if res else "timed out",
-            "codespace": res.codespace if res else "",
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "codespace": res.codespace,
             "hash": _hexu(tx_hash(raw)),
         }
 
@@ -517,6 +529,13 @@ def routes(env: Environment) -> dict:
         res = env.proxy_app_query.check_tx(abci.RequestCheckTx(tx=raw))
         return {"code": res.code, "data": _b64(res.data), "log": res.log,
                 "gas_wanted": str(res.gas_wanted)}
+
+    def ingress_stats():
+        """QoS ingress counters (admission/rejection/shed/preverify) for
+        operators and the e2e tx_flood perturbation's delta checks."""
+        if env.ingress is None:
+            return {"enabled": False}
+        return {"enabled": True, **env.ingress.stats()}
 
     def tx(hash="", prove=False):
         if env.tx_indexer is None:
@@ -692,6 +711,7 @@ def routes(env: Environment) -> dict:
         "broadcast_tx_sync": broadcast_tx_sync,
         "broadcast_tx_commit": broadcast_tx_commit,
         "check_tx": check_tx,
+        "ingress_stats": ingress_stats,
         "abci_info": abci_info,
         "abci_query": abci_query,
         "broadcast_evidence": broadcast_evidence,

@@ -44,3 +44,10 @@ def pytest_configure(config):
         "stall watchdog, restart-under-load with sub-second timeouts); "
         "runs in tier-1 — `-m liveness` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "ingress: QoS tx-ingress tests (envelope preverify, priority "
+        "lanes/WFQ, token buckets, load shedding); fast unit/property "
+        "tests run in tier-1, flood-scale runs carry `slow` too — "
+        "`-m ingress` selects just this group",
+    )

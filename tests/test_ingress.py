@@ -1,0 +1,472 @@
+"""QoS ingress pipeline coverage (mempool/ingress.py + mempool/lanes.py):
+envelope wire format, micro-batched signature pre-verification through the
+backend chain, priority lanes/WFQ, per-sender token buckets, load shedding,
+the 10:1 spammer starvation-freedom property, and chaos composition (a
+wedged preverify tier degrades admission to the cpu anchor without dropping
+valid txs)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClientCreator
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool.ingress import (
+    CODE_BAD_ENVELOPE,
+    CODE_INVALID_SIGNATURE,
+    CODE_QUEUE_FULL,
+    CODE_RATE_LIMITED,
+    CODE_TX_IN_CACHE,
+    BadEnvelope,
+    IngressPipeline,
+    decode_envelope,
+    encode_envelope,
+)
+from cometbft_tpu.mempool.lanes import LaneFull, LaneItem, LaneSet, TokenBucket
+from cometbft_tpu.sidecar import backend as be
+from cometbft_tpu.sidecar.backend import CpuBackend
+
+pytestmark = pytest.mark.ingress
+
+
+class CountingApp(abci.Application):
+    """Accepts every tx; counts CheckTx calls (invalid-sig rejections must
+    never reach the app)."""
+
+    def __init__(self):
+        self.check_calls = 0
+        self._mtx = threading.Lock()
+
+    def check_tx(self, req):
+        with self._mtx:
+            self.check_calls += 1
+        return abci.ResponseCheckTx(code=0, gas_wanted=1)
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    """Pin the process backend to the bare cpu anchor (tests that need a
+    different chain swap it themselves) and keep the verify cache clean."""
+    old = be._backend
+    be.set_backend(CpuBackend())
+    ed25519._verified.clear()
+    yield
+    ed25519._verified.clear()
+    be.set_backend(old)
+
+
+def _mk(app=None, window_ms=1.0, now=time.monotonic, **cfg_kwargs):
+    app = app or CountingApp()
+    cli = LocalClientCreator(app).new_abci_client()
+    cfg = MempoolConfig(ingress_window_ms=window_ms, **cfg_kwargs)
+    mp = CListMempool(cfg, cli)
+    ing = IngressPipeline(cfg, mp, now=now)
+    return app, mp, ing
+
+
+def _key(tag: bytes):
+    return ed25519.gen_priv_key_from_secret(tag)
+
+
+# -- envelope wire format ----------------------------------------------------
+
+
+def test_envelope_roundtrip():
+    priv = _key(b"rt")
+    tx = encode_envelope(priv, b"k=v", priority=7, nonce=42)
+    env = decode_envelope(tx)
+    assert env.pubkey == priv.pub_key().bytes()
+    assert env.priority == 7
+    assert env.nonce == 42
+    assert env.payload == b"k=v"
+    assert ed25519.PubKey(env.pubkey).verify_signature(
+        env.sign_bytes(), env.signature
+    )
+
+
+def test_legacy_passthrough_and_malformed():
+    assert decode_envelope(b"plain=tx") is None
+    assert decode_envelope(b"") is None
+    priv = _key(b"mal")
+    tx = encode_envelope(priv, b"k=v")
+    with pytest.raises(BadEnvelope):
+        decode_envelope(tx[:50])  # truncated envelope is an error...
+    with pytest.raises(BadEnvelope):
+        decode_envelope(bytes([tx[0], 99]) + tx[2:])  # ...so is a bad version
+    # distinct nonces are distinct txs
+    assert encode_envelope(priv, b"k=v", nonce=1) != encode_envelope(
+        priv, b"k=v", nonce=2
+    )
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_signed_and_legacy_admission():
+    app, mp, ing = _mk()
+    try:
+        codes = []
+        ing.check_tx(b"legacy=1", callback=lambda r: codes.append(r.code))
+        tx = encode_envelope(_key(b"ok"), b"signed=1", priority=2)
+        ing.check_tx(tx, callback=lambda r: codes.append(r.code))
+        assert ing.flush_queue()
+        time.sleep(0.05)
+        assert mp.size() == 2
+        assert codes == [0, 0]
+        lanes = {m.tx: m.lane for m in mp.txs_front()}
+        assert lanes[b"legacy=1"] == 0
+        assert lanes[tx] == 2
+    finally:
+        ing.close()
+
+
+def test_invalid_sig_rejected_without_waking_app():
+    app, mp, ing = _mk()
+    try:
+        tx = bytearray(encode_envelope(_key(b"bad"), b"k=v"))
+        tx[-1] ^= 0xFF
+        codes = []
+        ing.check_tx(bytes(tx), callback=lambda r: codes.append((r.code, r.codespace)))
+        assert ing.flush_queue()
+        time.sleep(0.05)
+        assert codes == [(CODE_INVALID_SIGNATURE, "ingress")]
+        assert mp.size() == 0
+        assert app.check_calls == 0, "bad-sig tx must never reach the app"
+        assert ing.counters["rejected_invalid_sig"] == 1
+    finally:
+        ing.close()
+
+
+def test_concurrent_senders_share_preverify_batches():
+    """8 senders x 32 envelopes submitted concurrently must coalesce into
+    far fewer preverify dispatches than txs (the micro-batch window)."""
+    app, mp, ing = _mk(window_ms=5.0, size=1000, cache_size=1000)
+    try:
+        k, per = 8, 32
+        privs = [_key(b"c-%d" % i) for i in range(k)]
+        barrier = threading.Barrier(k)
+
+        def sender(i):
+            barrier.wait()
+            for j in range(per):
+                ing.check_tx(
+                    encode_envelope(privs[i], b"c/%d/%d=v" % (i, j), nonce=j)
+                )
+
+        threads = [threading.Thread(target=sender, args=(i,)) for i in range(k)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ing.flush_queue(10.0)
+        deadline = time.monotonic() + 5.0
+        while mp.size() < k * per and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert mp.size() == k * per
+        assert ing.counters["preverify_batches"] < k * per / 4
+        assert ing.counters["preverify_batch_max"] > 1
+    finally:
+        ing.close()
+
+
+def test_gossip_duplicate_short_circuit():
+    """A tx already in the cache (gossip echo) is answered from the cache
+    path: no queue slot, no second preverify dispatch."""
+    app, mp, ing = _mk()
+    try:
+        tx = encode_envelope(_key(b"dup"), b"k=v")
+        ing.check_tx(tx, sender="peer-a")
+        assert ing.flush_queue()
+        time.sleep(0.05)
+        batches = ing.counters["preverify_batches"]
+        codes = []
+        ing.check_tx(tx, callback=lambda r: codes.append(r.code), sender="peer-b")
+        assert codes == [CODE_TX_IN_CACHE]
+        assert ing.counters["preverify_batches"] == batches
+        # the gossiping peer is recorded on the existing entry
+        entry = next(iter(mp.txs_front()))
+        assert "peer-b" in entry.senders
+    finally:
+        ing.close()
+
+
+# -- lanes / WFQ / token buckets --------------------------------------------
+
+
+def test_token_bucket_fake_clock():
+    t = [0.0]
+    b = TokenBucket(rate=2.0, burst=4.0, now=lambda: t[0])
+    assert [b.allow() for _ in range(4)] == [True] * 4
+    assert not b.allow()  # burst exhausted
+    t[0] += 1.0  # +2 tokens
+    assert b.allow() and b.allow() and not b.allow()
+
+
+def test_laneset_wfq_drain_order_and_shed():
+    ls = LaneSet(lanes=3, queue_max=4, sender_rps=0)
+    for lane in (0, 1, 2):
+        for j in range(4):
+            ls.push(LaneItem(tx=b"%d-%d" % (lane, j), lane=lane))
+    with pytest.raises(LaneFull):
+        ls.push(LaneItem(tx=b"overflow", lane=0))
+    order = [it.tx for it in ls.drain(12)]
+    assert len(order) == 12
+    # DRR quantum 2**lane: the first cycle grants lane2 4, lane1 2, lane0 1
+    assert order[:4] == [b"2-0", b"2-1", b"2-2", b"2-3"]
+    assert order.index(b"1-0") < order.index(b"0-0")
+    # FIFO within a lane
+    for lane in (0, 1, 2):
+        got = [t for t in order if t.startswith(b"%d-" % lane)]
+        assert got == sorted(got)
+    # low lane is never starved: all 12 drained
+    assert ls.size() == 0
+
+
+def test_laneset_per_sender_share_cap():
+    ls = LaneSet(lanes=1, queue_max=16, sender_rps=0, sender_share_div=4)
+    for j in range(4):  # share = 16 // 4 = 4
+        ls.push(LaneItem(tx=b"s%d" % j, sender="squatter"))
+    with pytest.raises(LaneFull):
+        ls.push(LaneItem(tx=b"s5", sender="squatter"))
+    ls.push(LaneItem(tx=b"h0", sender="honest"))  # others still fit
+
+
+def test_rate_limited_rejection():
+    t = [0.0]
+    app, mp, ing = _mk(ingress_sender_rps=2.0, now=lambda: t[0])
+    try:
+        priv = _key(b"rl")
+        codes = []
+        for j in range(10):
+            ing.check_tx(
+                encode_envelope(priv, b"rl/%d=v" % j, nonce=j),
+                callback=lambda r: codes.append(r.code),
+            )
+        limited = [c for c in codes if c == CODE_RATE_LIMITED]
+        assert limited, "burst above rps*2 must be rate limited"
+        assert ing.counters["shed_total"] >= len(limited)
+        # legacy txs carry no identity: never bucketed
+        ing.check_tx(b"legacy-unlimited=1")
+        assert ing.counters["rejected_rate_limited"] == len(limited)
+    finally:
+        ing.close()
+
+
+def test_queue_full_sheds_with_distinct_code():
+    # window large enough that nothing drains while we flood
+    app, mp, ing = _mk(window_ms=500.0, ingress_queue_max=4)
+    try:
+        priv = _key(b"qf")
+        codes = []
+        for j in range(20):
+            ing.check_tx(
+                encode_envelope(priv, b"qf/%d=v" % j, priority=0, nonce=j),
+                callback=lambda r: codes.append(r.code),
+            )
+        assert CODE_QUEUE_FULL in codes
+        assert ing.counters["rejected_queue_full"] > 0
+        assert ing.counters["shed_total"] > 0
+    finally:
+        ing.close()
+
+
+def test_bad_envelope_rejected():
+    app, mp, ing = _mk()
+    try:
+        codes = []
+        tx = encode_envelope(_key(b"bv"), b"k=v")
+        ing.check_tx(tx[:60], callback=lambda r: codes.append(r.code))
+        assert codes == [CODE_BAD_ENVELOPE]
+        assert app.check_calls == 0
+    finally:
+        ing.close()
+
+
+# -- lane-aware reap ---------------------------------------------------------
+
+
+def test_reap_drains_high_priority_lanes_first():
+    app, mp, ing = _mk(ingress_lanes=4)
+    try:
+        txs = {}
+        for pri in (0, 3, 1, 2):  # submitted out of priority order
+            tx = encode_envelope(_key(b"reap-%d" % pri), b"p%d=v" % pri, priority=pri)
+            txs[pri] = tx
+            ing.check_tx(tx)
+        assert ing.flush_queue()
+        deadline = time.monotonic() + 5.0
+        while mp.size() < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        reaped = mp.reap_max_bytes_max_gas(-1, -1)
+        assert reaped == [txs[3], txs[2], txs[1], txs[0]]
+    finally:
+        ing.close()
+
+
+# -- starvation-freedom property (satellite) ---------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_spammer_cannot_starve_honest_senders(seed):
+    """Seeded 10:1 offered-load property: one spammer offers 10x the load
+    of each honest sender into the same lane; every honest tx must be
+    reaped within K simulated blocks of its submission, and the spammer's
+    excess must be shed."""
+    K = 3
+    sim_seconds = 6
+    block_size = 48  # txs per simulated block
+    t = [float(seed)]
+    app, mp, ing = _mk(
+        ingress_sender_rps=10.0,
+        ingress_lanes=2,
+        ingress_queue_max=64,
+        window_ms=1.0,
+        size=5000,
+        cache_size=20000,
+        now=lambda: t[0],
+    )
+    try:
+        spammer = _key(b"spam-%d" % seed)
+        honest = [_key(b"hon-%d-%d" % (seed, i)) for i in range(3)]
+        pending = {}  # honest tx bytes -> submission block
+        height = 0
+        for sec in range(sim_seconds):
+            t[0] += 1.0
+            for j in range(100):  # spammer: 100 tx/s offered
+                ing.check_tx(
+                    encode_envelope(
+                        spammer, b"s/%d/%d/%d=v" % (seed, sec, j),
+                        priority=1, nonce=sec * 1000 + j,
+                    )
+                )
+            for i, priv in enumerate(honest):  # honest: 10 tx/s offered total
+                for j in range(3):
+                    tx = encode_envelope(
+                        priv, b"h/%d/%d/%d/%d=v" % (seed, sec, i, j),
+                        priority=1, nonce=sec * 10 + j,
+                    )
+                    codes = []
+                    ing.check_tx(tx, callback=lambda r: codes.append(r.code))
+                    pending[tx] = height
+            assert ing.flush_queue(10.0)
+            time.sleep(0.05)
+            # one simulated block: lane-aware reap + commit
+            height += 1
+            reaped = mp.reap_max_bytes_max_gas(block_size * 200, -1)
+            mp.lock()
+            try:
+                mp.update(
+                    height, reaped,
+                    [abci.ResponseDeliverTx(code=0)] * len(reaped), None, None,
+                )
+            finally:
+                mp.unlock()
+            for tx in reaped:
+                if tx in pending:
+                    assert height - pending[tx] <= K
+                    del pending[tx]
+        # drain the tail: every honest tx still pending must clear within K
+        for _ in range(K):
+            height += 1
+            reaped = mp.reap_max_bytes_max_gas(block_size * 200, -1)
+            mp.lock()
+            try:
+                mp.update(
+                    height, reaped,
+                    [abci.ResponseDeliverTx(code=0)] * len(reaped), None, None,
+                )
+            finally:
+                mp.unlock()
+            for tx in reaped:
+                pending.pop(tx, None)
+        assert not pending, f"{len(pending)} honest txs starved"
+        assert ing.counters["shed_total"] > 0, "the spammer was never shed"
+        assert ing.counters["rejected_invalid_sig"] == 0
+    finally:
+        ing.close()
+
+
+# -- chaos composition (satellite) -------------------------------------------
+
+
+@pytest.mark.chaos
+def test_wedged_preverify_tier_degrades_to_cpu_anchor():
+    """A fully wedged primary preverify tier (chaos wedge > deadline) must
+    degrade admission to the cpu anchor — slower, never lossy."""
+    from cometbft_tpu.sidecar.chaos import ChaosBackend
+    from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+    chain = ResilientBackend(
+        [
+            ("tpu", ChaosBackend(CpuBackend(), "wedge:1.0:500", seed=3)),
+            ("cpu", CpuBackend()),
+        ],
+        deadline_ms=50,
+        retries=0,
+        backoff_ms=1,
+        breaker_threshold=1,
+        breaker_cooldown_ms=60000,
+        crosscheck="off",
+    )
+    be.set_backend(chain)
+    app, mp, ing = _mk(size=1000, cache_size=1000)
+    try:
+        privs = [_key(b"chaos-%d" % i) for i in range(4)]
+        n = 40
+        for j in range(n):
+            ing.check_tx(
+                encode_envelope(privs[j % 4], b"ch/%d=v" % j, nonce=j)
+            )
+        assert ing.flush_queue(20.0)
+        deadline = time.monotonic() + 10.0
+        while mp.size() < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mp.size() == n, "degraded chain must not drop valid txs"
+        assert chain.counters_["degraded_calls"] > 0, "anchor never engaged"
+        assert ing.counters["rejected_invalid_sig"] == 0
+    finally:
+        ing.close()
+        chain.close()
+
+
+# -- broadcast_tx_sync timeout (satellite) -----------------------------------
+
+
+def test_broadcast_tx_sync_timeout_is_rpc_error():
+    """The sync broadcast timeout comes from config.rpc and surfaces as a
+    proper RPCError, not a fake code=-1 result."""
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.rpc.core import Environment, routes
+    from cometbft_tpu.rpc.jsonrpc.server import RPCError
+
+    class BlackholeMempool:
+        def check_tx(self, tx, callback=None, sender=""):
+            pass  # never answers
+
+    cfg = test_config()
+    cfg.rpc.timeout_broadcast_tx_commit = 0.05
+    table = routes(Environment(config=cfg, mempool=BlackholeMempool()))
+    t0 = time.monotonic()
+    with pytest.raises(RPCError) as exc:
+        table["broadcast_tx_sync"](tx="0x" + b"ping".hex())
+    assert time.monotonic() - t0 < 2.0, "must honor the configured timeout"
+    assert exc.value.code == -32603
+    assert "timed out" in exc.value.message
+
+
+def test_ingress_stats_route():
+    from cometbft_tpu.rpc.core import Environment, routes
+
+    app, mp, ing = _mk()
+    try:
+        table = routes(Environment(mempool=ing, ingress=ing))
+        st = table["ingress_stats"]()
+        assert st["enabled"] is True
+        assert "shed_total" in st and "lane_depths" in st
+        assert routes(Environment())["ingress_stats"]() == {"enabled": False}
+    finally:
+        ing.close()

@@ -97,3 +97,66 @@ def test_recheck_evicts_newly_invalid_txs():
     finally:
         mp.unlock()
     assert mp.reap_max_txs(-1) == [b"4", b"5"], "recheck must evict below-floor txs"
+
+
+class CountingProxy:
+    """Wraps an ABCI client to count how the mempool drives it."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.async_calls = 0
+        self.sync_calls = 0
+        self.flushes = 0
+
+    def check_tx_async(self, req, callback=None):
+        self.async_calls += 1
+        return self._inner.check_tx_async(req, callback)
+
+    def check_tx(self, req):
+        self.sync_calls += 1
+        return self._inner.check_tx(req)
+
+    def flush(self):
+        self.flushes += 1
+        return self._inner.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_recheck_runs_as_one_async_wave():
+    """A 500-tx survivor set must recheck as one batched wave: per-tx async
+    dispatches followed by a single flush — not 500 sync round-trips."""
+    app = CounterApp()
+    proxy = CountingProxy(LocalClientCreator(app).new_abci_client())
+    mp = CListMempool(MempoolConfig(size=1000, cache_size=2000), proxy)
+    n = 500
+    for i in range(n):
+        mp.check_tx(b"%d" % i)
+    assert mp.size() == n
+    proxy.async_calls = proxy.sync_calls = proxy.flushes = 0
+    app.floor = 100  # txs 0..99 become invalid on recheck
+    mp.lock()
+    try:
+        mp.update(1, [], [], None, None)
+    finally:
+        mp.unlock()
+    assert mp.size() == n - 100
+    assert proxy.async_calls == n, "every survivor rechecked asynchronously"
+    assert proxy.sync_calls == 0, "recheck must not serialize sync round-trips"
+    assert proxy.flushes == 1, "exactly one flush drives the whole wave"
+
+
+def test_reap_orders_by_lane_then_fifo():
+    """Lane-tagged txs reap high-lane-first, FIFO within a lane; with no
+    lane tags the reference FIFO order is preserved exactly."""
+    app, mp = _mk()
+    for v, lane in ((10, 0), (11, 2), (12, 1), (13, 2), (14, 0)):
+        mp.check_tx(b"%d" % v, lane=lane)
+    assert mp.reap_max_bytes_max_gas(-1, -1) == [
+        b"11", b"13", b"12", b"10", b"14"
+    ]
+    app2, mp2 = _mk()
+    for v in (20, 21, 22):
+        mp2.check_tx(b"%d" % v)
+    assert mp2.reap_max_bytes_max_gas(-1, -1) == [b"20", b"21", b"22"]

@@ -62,16 +62,22 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce stage rides along in the carried JSON (scheduler
-      # speedup measured on this host while the device was serving);
-      # surface it in the history. Helper python is CPU-only parsing.
+      # The coalesce + ingress stages ride along in the carried JSON
+      # (host-side scheduler/admission speedups measured while the device
+      # was serving); surface them in the history. Neither gates alt-mode
+      # adoption below. Helper python is CPU-only parsing.
       CO=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu timeout 60 \
            python - <<'PYEOF' 2>/dev/null
 import json
 rec = json.load(open("tpu_bench_latest.json"))
 c = rec.get("stages", {}).get("coalesce")
-print(f"coalesce {c['speedup']}x ratio {c['coalesce_ratio']}" if c
-      else "coalesce absent")
+parts = [f"coalesce {c['speedup']}x ratio {c['coalesce_ratio']}" if c
+         else "coalesce absent"]
+g = rec.get("stages", {}).get("ingress")
+parts.append(
+    f"ingress {g['speedup']}x {g['batched_dispatches']}dsp "
+    f"shed {g['shed_total']}" if g else "ingress absent")
+print("; ".join(parts))
 PYEOF
       )
       log "device bench OK -> tpu_bench_latest.json ($CO)"
