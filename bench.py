@@ -118,12 +118,17 @@ def run_phase_logged(args: list, timeout_s: int, tag: str, env=None):
 # -- workload builders (host crypto is C-speed) --------------------------------
 
 
-def _devnet_throughput(seconds: float = 12.0, n_vals: int = 4):
+def _devnet_throughput(
+    seconds: float = 12.0, n_vals: int = 4, target_blocks: int | None = None
+):
     """System-level stage: an in-process 4-validator devnet over real TCP
     (SecretConnection, gossip, mempool) under continuous tx load. Returns
     (blocks/s, committed tx/s) — the analog of the reference's QA
     saturation measurements (docs/qa/: ~0.7 blocks/s, ~400 tx/s on a
-    200-node DigitalOcean testnet; here everything shares one host)."""
+    200-node DigitalOcean testnet; here everything shares one host).
+    `target_blocks` ends the run early once that many blocks committed
+    (`seconds` stays the hard cap) — the hotpath A/B uses it so both arms
+    measure the same amount of work."""
     import threading
 
     from cometbft_tpu.abci.client import LocalClientCreator
@@ -176,7 +181,14 @@ def _devnet_throughput(seconds: float = 12.0, n_vals: int = 4):
         threading.Thread(target=pump, daemon=True).start()
         t0 = time.time()
         h0 = nodes[0].block_store.height()  # committed-height semantics
-        time.sleep(seconds)
+        deadline = t0 + seconds
+        while time.time() < deadline:
+            time.sleep(0.25)
+            if (
+                target_blocks is not None
+                and nodes[0].block_store.height() - h0 >= target_blocks
+            ):
+                break
         stop[0] = True
         dt = time.time() - t0
         h1 = nodes[0].block_store.height()
@@ -918,6 +930,224 @@ def _ingress_stage(stages: dict, plog) -> None:
         _be.set_backend(old_backend)
 
 
+def _hotpath_stage(stages: dict, plog) -> None:
+    """Consensus hot path (ISSUE 6): vote-admission micro-batching A/B plus
+    a devnet before/after.
+
+    Micro-stage: K peers x M precommits each, admitted into K INDEPENDENT
+    VoteSets — one VoteSet serializes admissions on its own mutex (the
+    reference's addVote locking), so the window-sharing surface is many
+    in-process nodes, the devnet shape.  The serialized arm pays one device
+    dispatch per vote (SigBatcher inline mode); the batched arm lets the
+    concurrent admissions share CMTPU_VOTE_BATCH_WINDOW_MS windows.  Both
+    arms run the same votes over the same host-crypto backend wrapped with
+    a fixed per-dispatch latency (CMTPU_BENCH_HOTPATH_DISPATCH_MS, default
+    20 ms — well under the 50-150 ms the axon tunnel actually measures per
+    dispatch), and the latency backend SERIALIZES dispatches: one device
+    executes one dispatch at a time, so overlapping the sleeps would model
+    an infinitely parallel device and hide exactly the cost batching
+    removes.  The simulated cost is labeled in the JSON
+    (`simulated_dispatch_ms`; 0 measures raw host-crypto batching alone).
+
+    Devnet sub-stage: the in-process devnet run twice over real TCP —
+    hot-path features forced off (window 0, pipeline off, group commit off)
+    vs on — reporting blocks/s + tx/s for both arms.  On one host the
+    in-process nodes share the verified-triple cache and consensus is
+    timeout-paced, so this arm is expected to be flat; it is reported so
+    the micro-stage's dispatch-bound win is never mistaken for a claim
+    about timeout-bound block rate."""
+    import threading as _threading
+
+    from cometbft_tpu.crypto import ed25519 as _ed
+    from cometbft_tpu.crypto import sigbatch
+    from cometbft_tpu.sidecar import backend as _be
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.state import make_genesis_state
+    from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+    from cometbft_tpu.types.block import PRECOMMIT_TYPE
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.vote_set import VoteSet
+
+    k = int(os.environ.get("CMTPU_BENCH_HOTPATH_PEERS", "8"))
+    per = int(os.environ.get("CMTPU_BENCH_HOTPATH_VOTES", "16"))
+    dispatch_ms = float(os.environ.get("CMTPU_BENCH_HOTPATH_DISPATCH_MS", "20"))
+    chain_id = "bench-hotpath"
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+    class _DeviceLatency:
+        """CpuBackend plus the fixed per-dispatch device cost; the lock is
+        the device itself — dispatches execute one at a time."""
+
+        name = "latency"
+
+        def __init__(self):
+            self._cpu = CpuBackend()
+            self._mtx = _threading.Lock()
+            self.calls = 0
+
+        def batch_verify(self, pubs, msgs, sigs_):
+            with self._mtx:
+                self.calls += 1
+                if dispatch_ms > 0:
+                    time.sleep(dispatch_ms / 1000.0)
+                return self._cpu.batch_verify(pubs, msgs, sigs_)
+
+        def merkle_root(self, leaves):
+            return self._cpu.merkle_root(leaves)
+
+    def _mk_rig(tag):
+        pvs = [MockPV() for _ in range(per)]
+        gen = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time=Time(1700000000, 0),
+            validators=[
+                GenesisValidator(pv.address(), pv.get_pub_key(), 10, "")
+                for pv in pvs
+            ],
+        )
+        gen.validate_and_complete()
+        vals = make_genesis_state(gen).validators
+        by_addr = {pv.address(): pv for pv in pvs}
+        ordered = [by_addr[v.address] for v in vals.validators]
+        votes = [
+            pv.sign_vote(
+                chain_id,
+                Vote(
+                    type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+                    timestamp=Time(1700000001, tag),
+                    validator_address=pv.address(), validator_index=i,
+                ),
+            )
+            for i, pv in enumerate(ordered)
+        ]
+        return vals, votes
+
+    rigs = [_mk_rig(i) for i in range(k)]
+    plog(f"hotpath fixture built ({k} peers x {per} votes)")
+
+    def _admit_arm(batcher):
+        old_b = sigbatch.set_batcher(batcher)
+        with _ed._verified_lock:
+            _ed._verified.clear()
+        errs: list[str] = []
+        sums: list[int] = []
+        lock = _threading.Lock()
+        barrier = _threading.Barrier(k)
+
+        def worker(vals, votes):
+            vs = VoteSet(chain_id, 1, 0, PRECOMMIT_TYPE, vals)
+            barrier.wait()
+            for v in votes:
+                try:
+                    if not vs.add_vote(v):
+                        with lock:
+                            errs.append("vote not added")
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errs.append(repr(e))
+            with lock:
+                sums.append(vs.sum)
+
+        threads = [
+            _threading.Thread(target=worker, args=rig, daemon=True)
+            for rig in rigs
+        ]
+        t1 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        dt = time.perf_counter() - t1
+        sigbatch.set_batcher(old_b)
+        assert not errs, f"hotpath arm rejected valid votes: {errs[:3]}"
+        assert sums == [per * 10] * k, "a valid vote was dropped"
+        return dt
+
+    lat = _DeviceLatency()
+    old_backend = _be._backend
+    _be.set_backend(lat)
+    try:
+        ser_s = _admit_arm(sigbatch.SigBatcher(window_ms=0, inline=True))
+        ser_dispatches = lat.calls
+        batched = sigbatch.SigBatcher(window_ms=2)
+        bat_s = _admit_arm(batched)
+        bat_dispatches = lat.calls - ser_dispatches
+        bc = batched.counters()
+    finally:
+        with _ed._verified_lock:
+            _ed._verified.clear()
+        _be.set_backend(old_backend)
+
+    st = {
+        "peers": k,
+        "votes_per_peer": per,
+        "simulated_dispatch_ms": dispatch_ms,
+        "serialized_ms": round(ser_s * 1000, 1),
+        "batched_ms": round(bat_s * 1000, 1),
+        "speedup": round(ser_s / bat_s, 2) if bat_s > 0 else 0.0,
+        "serialized_dispatches": ser_dispatches,
+        "batched_dispatches": bat_dispatches,
+        "batched_max_batch": bc["max_batch"],
+        "batched_fallbacks": bc["fallbacks"],
+    }
+    plog(
+        f"hotpath votes: serialized {st['serialized_ms']:.0f} ms "
+        f"({ser_dispatches} dispatches) -> batched {st['batched_ms']:.0f} ms "
+        f"({bat_dispatches} dispatches, max batch {bc['max_batch']}): "
+        f"{st['speedup']}x @ {dispatch_ms:.0f} ms simulated dispatch"
+    )
+
+    # ---- devnet before/after: the same system stage, features off vs on ----
+    n_vals = int(os.environ.get("CMTPU_BENCH_HOTPATH_VALS", "4"))
+    blocks = int(os.environ.get("CMTPU_BENCH_HOTPATH_BLOCKS", "40"))
+    knobs = (
+        "CMTPU_VOTE_BATCH_WINDOW_MS",
+        "CMTPU_BLOCKSYNC_PIPELINE",
+        "CMTPU_WAL_GROUP_MS",
+    )
+    saved = {kk: os.environ.get(kk) for kk in knobs}
+
+    def _devnet_arm(window, pipeline, group):
+        os.environ["CMTPU_VOTE_BATCH_WINDOW_MS"] = window
+        os.environ["CMTPU_BLOCKSYNC_PIPELINE"] = pipeline
+        os.environ["CMTPU_WAL_GROUP_MS"] = group
+        sigbatch.reset()  # singleton re-reads the window env on next use
+        with _ed._verified_lock:
+            _ed._verified.clear()
+        return _devnet_throughput(
+            seconds=15.0, n_vals=n_vals, target_blocks=blocks
+        )
+
+    try:
+        bps0, tps0 = _devnet_arm("0", "0", "0")
+        bps1, tps1 = _devnet_arm("2", "1", "2")
+    finally:
+        for kk, vv in saved.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+        sigbatch.reset()
+    st.update(
+        {
+            "devnet_vals": n_vals,
+            "devnet_target_blocks": blocks,
+            "devnet_before_blocks_per_s": round(bps0, 2),
+            "devnet_before_tx_per_s": round(tps0, 1),
+            "devnet_after_blocks_per_s": round(bps1, 2),
+            "devnet_after_tx_per_s": round(tps1, 1),
+            "devnet_speedup": round(bps1 / bps0, 2) if bps0 > 0 else 0.0,
+        }
+    )
+    stages["hotpath"] = st
+    plog(
+        f"hotpath devnet ({n_vals} vals, {blocks}-block target): "
+        f"off {bps0:.2f} blocks/s {tps0:.0f} tx/s -> "
+        f"on {bps1:.2f} blocks/s {tps1:.0f} tx/s ({st['devnet_speedup']}x)"
+    )
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -994,6 +1224,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _ingress_stage(stages, plog)
         except Exception as e:
             plog(f"ingress stage failed: {type(e).__name__}: {e}")
+
+    # ---- consensus hot path: micro-batched vote admission + devnet A/B ----
+    if budget_left():
+        try:
+            _hotpath_stage(stages, plog)
+        except Exception as e:
+            plog(f"hotpath stage failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":

@@ -12,6 +12,7 @@ Wire (proto/tendermint/blocksync/types.proto): Message oneof
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -77,6 +78,17 @@ class BlocksyncReactor(Reactor):
         self._running = False
         self.synced = False
         self._prefetched_to = 0  # height up to which the window was batched
+        # One-deep verify/apply pipeline: the prefetch producer (device-
+        # bound commit verification for the window ahead) runs on a worker
+        # while apply_block (app-bound) runs on the sync thread, so the
+        # serial per-block decision path below stays unchanged and lands on
+        # cache hits. CMTPU_BLOCKSYNC_PIPELINE=0 restores the inline
+        # prefetch-then-verify ordering.
+        self._pipeline_enabled = (
+            os.environ.get("CMTPU_BLOCKSYNC_PIPELINE", "1") != "0"
+        )
+        self._pf_job: tuple[threading.Event, list[float]] | None = None
+        self.pipeline_overlap_ms = 0.0  # verify/apply overlap accumulated
 
     def get_channels(self):
         return [
@@ -248,13 +260,57 @@ class BlocksyncReactor(Reactor):
         except Exception:
             self._prefetched_to = self.pool.height + 1
 
+    # -- verify/apply pipeline ------------------------------------------------
+
+    def _pipeline_submit(self) -> None:
+        """Kick the prefetch producer on a worker so it overlaps the
+        apply_block that follows. One-deep: a still-running job means the
+        producer is already ahead — never stack a second one."""
+        job = self._pf_job
+        if job is not None and not job[0].is_set():
+            return
+        done = threading.Event()
+        times = [time.monotonic(), 0.0]
+
+        def run():
+            try:
+                self._prefetch_verify_window()
+            finally:
+                times[1] = time.monotonic()
+                done.set()
+
+        self._pf_job = (done, times)
+        threading.Thread(target=run, daemon=True).start()
+
+    def _pipeline_wait(self) -> None:
+        """Barrier before the serial verify: the producer must have finished
+        populating the verified cache for the height we are about to check.
+        Bounded — _prefetch_verify_window swallows its own errors, so the
+        worker always terminates."""
+        job = self._pf_job
+        if job is not None:
+            job[0].wait(timeout=60.0)
+
+    def _pipeline_account(self, apply_t0: float, apply_t1: float) -> None:
+        job = self._pf_job
+        if job is None:
+            return
+        done, times = job
+        end = times[1] if done.is_set() else apply_t1
+        overlap = min(apply_t1, end) - max(apply_t0, times[0])
+        if overlap > 0:
+            self.pipeline_overlap_ms += overlap * 1000.0
+
     def _try_sync_one(self) -> bool:
         """reactor.go:340-400 trySync: verify `first` with `second.LastCommit`
         (VerifyCommitLight — batched on device), then apply."""
         first, second = self.pool.peek_two_blocks()
         if first is None or second is None:
             return False
-        self._prefetch_verify_window()
+        if self._pipeline_enabled:
+            self._pipeline_wait()
+        else:
+            self._prefetch_verify_window()
         first_parts = first.make_part_set()
         first_id = BlockID(first.hash(), first_parts.header())
         try:
@@ -271,6 +327,17 @@ class BlocksyncReactor(Reactor):
                     self.switch.stop_peer_for_error(peer, "sent us an invalid block")
             return False
         self.block_store.save_block(first, first_parts, second.last_commit)
-        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
+        if self._pipeline_enabled:
+            # Overlap the next window's verification (device) with this
+            # block's application (app). The worker only POPULATES the
+            # verified-triple cache — the accepting verify_commit_light
+            # above still runs serially on this thread, so a validator-set
+            # change simply misses the cache and verifies inline.
+            self._pipeline_submit()
+            t0 = time.monotonic()
+            self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
+            self._pipeline_account(t0, time.monotonic())
+        else:
+            self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
         self.pool.pop_request()
         return True

@@ -668,6 +668,25 @@ class ConsensusState:
             return
         from cometbft_tpu.types.vote_set import VoteSet
 
+        # Pre-verify the whole seen commit in ONE dispatch so the serial
+        # add_vote loop below runs on cache hits — otherwise every signature
+        # would pay a scalar verify (or a micro-batch window wait) one at a
+        # time at boot. Purely an optimization: failures just miss the cache
+        # and add_vote verifies as before.
+        try:
+            from cometbft_tpu.crypto import ed25519 as _ed
+
+            vals = state.last_validators
+            if all(isinstance(v.pub_key, _ed.PubKey) for v in vals.validators):
+                bv = _ed.BatchVerifier()
+                sbs = seen_commit.vote_sign_bytes_all(state.chain_id)
+                for idx, cs in enumerate(seen_commit.signatures):
+                    if not cs.is_absent():
+                        bv.add(vals.validators[idx].pub_key, sbs[idx], cs.signature)
+                if len(bv) >= 2:
+                    bv.verify()
+        except Exception:
+            pass
         vote_set = VoteSet(
             state.chain_id,
             state.last_block_height,
@@ -1392,6 +1411,23 @@ class ConsensusState:
                     f"type={msg_type}: {e}"
                 )
             return None
+        # An in-process FilePV's signature is valid by construction (it just
+        # computed it over exactly these sign bytes) — prove the triple into
+        # the verified cache so our own admission is a dict hit instead of a
+        # crypto call or a micro-batch window wait. Remote/untrusted signers
+        # keep the full verify: a byzantine privval must not be able to
+        # plant unverified triples.
+        try:
+            from cometbft_tpu.crypto import ed25519 as _ed
+            from cometbft_tpu.privval.file import FilePV as _FilePV
+
+            pk = self.priv_validator_pub_key
+            if isinstance(self.priv_validator, _FilePV) and isinstance(pk, _ed.PubKey):
+                _ed._verified_put(
+                    (pk.bytes(), bytes(vote.signature), vote.sign_bytes(self.state.chain_id))
+                )
+        except Exception:
+            pass
         self._send_internal(VoteMessage(vote))
         return vote
 

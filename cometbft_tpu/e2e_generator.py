@@ -15,7 +15,11 @@ Profiles mirror the reference's groups:
   joins via blocksync or verified statesync, validator churn, hybrid
   backend, any perturbation — including ``backend_faults``, which
   restarts a node with a chaos-injected supervised verification chain
-  (CMTPU_FAULTS, sidecar/chaos.py) and demands it keeps committing.
+  (CMTPU_FAULTS, sidecar/chaos.py) and demands it keeps committing, and
+  ``vote_batch``, which restarts a node with a widened vote-admission
+  micro-batch window (CMTPU_VOTE_BATCH_WINDOW_MS) on top of that faulted
+  chain and demands the validator's precommit lands in a fresh commit —
+  batching under faults must degrade, never drop, valid votes.
 * ``small`` — the CI-sized corner (≤4 validators, ≤6 target blocks, ≤1
   perturbation, ed25519 only, cpu backend): what ``e2e matrix`` smokes in
   the test tier.
@@ -49,7 +53,7 @@ _ABCI_FULL = ("local",) * 5 + ("socket",) * 3 + ("grpc",) * 2
 _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
 _PERTURB_FULL = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
-    "concurrent_light_clients", "tx_flood",
+    "concurrent_light_clients", "tx_flood", "vote_batch",
 )
 _PERTURB_SMALL = ("pause", "restart", "backend_faults", "tx_flood")
 

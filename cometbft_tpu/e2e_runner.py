@@ -4,7 +4,9 @@ test/e2e/runner).
 The reference drives docker-compose testnets from a TOML manifest: node
 topology, per-node perturbation schedules (kill / pause / disconnect /
 restart — plus this framework's own ``backend_faults``, which restarts a
-node with a chaos-injected supervised verification chain), transaction
+node with a chaos-injected supervised verification chain, and
+``vote_batch``, which does that with a widened vote-admission micro-batch
+window and asserts the validator's precommit still lands), transaction
 load, then a liveness + hash-agreement check and an optional benchmark
 report.  This is that runner over OS processes on
 loopback (the deployment substrate this framework's e2e tier uses —
@@ -61,7 +63,7 @@ MODES = ("validator", "full", "seed")
 ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
-    "concurrent_light_clients", "tx_flood",
+    "concurrent_light_clients", "tx_flood", "vote_batch",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -201,6 +203,11 @@ class E2ERunner:
         # the per-node results of the tx_flood perturbation.
         self._flood_armed: set[str] = set()
         self._tx_floods: dict[str, dict] = {}
+        # Nodes relaunched with a widened vote-admission micro-batch window
+        # on top of the faulted chain, and the per-node results of the
+        # vote_batch perturbation's zero-valid-vote-loss probe.
+        self._votebatch_armed: set[str] = set()
+        self._vote_batches: dict[str, dict] = {}
         # Stall forensics: every node's consensus round-state, captured at
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
@@ -361,6 +368,12 @@ class E2ERunner:
         env = self._node_env()
         if node.name in self._fault_armed:
             env.update(self._fault_env(idx))
+        if node.name in self._votebatch_armed:
+            # vote_batch: widen the admission micro-batch window (5x the
+            # default, so concurrent peer admissions really share windows)
+            # and keep the chaos-faulted supervised chain underneath it.
+            env.update(self._fault_env(idx))
+            env["CMTPU_VOTE_BATCH_WINDOW_MS"] = "10"
         if node.name in self._flood_armed:
             # tx_flood arms a finite per-sender admission rate so the
             # hostile signer gets shed instead of squatting the mempool.
@@ -495,6 +508,20 @@ class E2ERunner:
             h0 = self.wait_height(self.manifest.nodes[0].name, 1)
             self.wait_height(name, h0 + 1, timeout=420)
             self._tx_floods[name] = self._tx_flood(node)
+        elif kind == "vote_batch":
+            # Relaunch with a widened vote-admission micro-batch window AND
+            # the chaos-faulted supervised chain armed (_launch reads
+            # _votebatch_armed), then demand the armed validator's precommit
+            # lands in a commit minted AFTER the restart: micro-batched
+            # admission under injected backend faults must degrade, never
+            # drop, valid votes.
+            self._votebatch_armed.add(name)
+            h0 = self._height(self.manifest.nodes[0].name)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+            self._vote_batches[name] = self._vote_batch_check(name, h0)
         elif kind == "concurrent_light_clients":
             # No process disruption: the stress IS the perturbation.  N
             # light clients bisect against this node simultaneously; their
@@ -708,6 +735,65 @@ class E2ERunner:
             out["coalesce"] = delta
         return out
 
+    def _vote_batch_check(self, name: str, after_height: int) -> dict:
+        """Zero-valid-vote-loss probe for the vote_batch perturbation: scan
+        commits minted after the restart until one carries the armed
+        validator's BLOCK_ID_FLAG_COMMIT signature.  A widened window plus
+        injected faults may slow admission (degraded tiers, retries) but a
+        single lost valid precommit would show up here as the signature
+        never landing.  Non-validator nodes have no precommit to lose —
+        recorded and skipped."""
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types.block import BLOCK_ID_FLAG_COMMIT
+
+        ref = self.manifest.nodes[0].name
+        ref_cli = HTTPClient(
+            f"http://127.0.0.1:{self.rpc_ports[ref]}", timeout=5
+        )
+        deadline = time.time() + 300
+        val_info: dict = {}
+        while time.time() < deadline and not val_info.get("address"):
+            try:
+                val_info = HTTPClient(
+                    f"http://127.0.0.1:{self.rpc_ports[name]}", timeout=5
+                ).status()["validator_info"]
+            except Exception:
+                time.sleep(1.0)
+        addr = (val_info.get("address") or "").upper()
+        if not addr or int(val_info.get("voting_power", "0") or 0) <= 0:
+            self.log(f"vote_batch {name}: not a validator; sig probe skipped")
+            return {"validator": False, "signed": False}
+        scanned = 0
+        probe = after_height + 1
+        while time.time() < deadline:
+            h = self._height(ref)
+            while probe <= h:
+                sh = ref_cli.commit(probe).get("signed_header") or {}
+                for s in (sh.get("commit") or {}).get("signatures", []):
+                    if (
+                        (s.get("validator_address") or "").upper() == addr
+                        and int(s.get("block_id_flag", 0)) == BLOCK_ID_FLAG_COMMIT
+                    ):
+                        self.log(
+                            f"vote_batch {name}: precommit landed at height "
+                            f"{probe} ({scanned} commits scanned)"
+                        )
+                        return {
+                            "validator": True,
+                            "signed": True,
+                            "height": probe,
+                            "commits_scanned": scanned + 1,
+                        }
+                scanned += 1
+                probe += 1
+            time.sleep(1.0)
+        raise AssertionError(
+            f"{name}: no post-restart commit signature within the "
+            f"vote_batch window ({scanned} commits after height "
+            f"{after_height}) — a valid precommit was lost or the node "
+            f"never rejoined"
+        )
+
     def _tx_flood(
         self,
         node: ManifestNode,
@@ -887,6 +973,8 @@ class E2ERunner:
                 report["concurrent_light_clients"] = self._light_swarms
             if self._tx_floods:
                 report["tx_flood"] = self._tx_floods
+            if self._vote_batches:
+                report["vote_batch"] = self._vote_batches
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

@@ -87,8 +87,22 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> 
             f"total voting power from the evidence and our validator set does not match "
             f"({ev.total_voting_power} != {val_set.total_voting_power()})"
         )
-    va.verify(chain_id, pub_key)
-    vb.verify(chain_id, pub_key)
+    # vote.verify semantics, but the two signatures ride ONE batched
+    # dispatch through the micro-batch window — duplicate-vote evidence
+    # always carries exactly two sigs from the same key.
+    from cometbft_tpu.crypto import sigbatch
+    from cometbft_tpu.types.vote import VoteError
+
+    addr = pub_key.address()
+    if addr != va.validator_address or addr != vb.validator_address:
+        raise VoteError("invalid validator address")
+    ok_a, ok_b = sigbatch.verify_triples(
+        [pub_key, pub_key],
+        [va.sign_bytes(chain_id), vb.sign_bytes(chain_id)],
+        [va.signature, vb.signature],
+    )
+    if not ok_a or not ok_b:
+        raise VoteError("invalid signature")
 
 
 def verify_light_client_attack(

@@ -176,6 +176,7 @@ class Node:
             reg.gauge_func("blockstore", "base", "Block store base height.",
                            lambda: self.block_store.base())
             self._register_backend_metrics(reg)
+            self._register_hotpath_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
             host, _, port = addr.rpartition(":")
             self.metrics_server = MetricsServer(
@@ -376,6 +377,45 @@ class Node:
         reg.gauge_func("scheduler", "queue_wait_p95_us",
                        "95th-percentile coalescer queue wait, microseconds.",
                        sched_sample("queue_wait_p95_us"))
+
+    def _register_hotpath_metrics(self, reg) -> None:
+        """Consensus hot-path gauges: the vote-admission micro-batcher, WAL
+        group commit, and the blocksync verify/apply pipeline. Lazy like the
+        backend gauges — `sigbatch.counters()` never constructs a batcher,
+        and the WAL/blocksync reads are getattr probes on objects built
+        later in __init__, so a scrape is always side-effect free."""
+        from cometbft_tpu.crypto import sigbatch
+
+        def vb(key):
+            return lambda: sigbatch.counters().get(key, 0)
+
+        def vb_ratio():
+            c = sigbatch.counters()
+            return int(1000 * c["requests"] / max(1, c["dispatches"]))
+
+        reg.gauge_func("vote_batch", "requests",
+                       "Signature-verify requests to the vote micro-batcher.",
+                       vb("requests"))
+        reg.gauge_func("vote_batch", "dispatches",
+                       "Columnar dispatches the vote micro-batcher issued.",
+                       vb("dispatches"))
+        reg.gauge_func("vote_batch", "coalesce_ratio_milli",
+                       "Vote-batch requests per dispatch x1000.",
+                       vb_ratio)
+        reg.gauge_func("vote_batch", "cache_hits",
+                       "Vote admissions answered by the verified-triple cache.",
+                       vb("cache_hits"))
+        reg.gauge_func("wal", "group_commits_total",
+                       "WAL fsyncs that covered more than one write_sync caller.",
+                       lambda: getattr(
+                           getattr(getattr(self, "consensus_state", None),
+                                   "wal", None),
+                           "group_commits", 0) or 0)
+        reg.gauge_func("blocksync", "pipeline_overlap_ms",
+                       "Accumulated verify/apply overlap in blocksync, ms.",
+                       lambda: int(getattr(
+                           getattr(self, "blocksync_reactor", None),
+                           "pipeline_overlap_ms", 0) or 0))
 
     # -- lifecycle ------------------------------------------------------------
 

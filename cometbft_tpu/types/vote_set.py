@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 
+from cometbft_tpu.crypto import sigbatch
 from cometbft_tpu.libs.bit_array import BitArray
 from cometbft_tpu.types.block import BlockID, Commit
 from cometbft_tpu.types.vote import Vote, vote_to_commit_sig
@@ -119,9 +120,19 @@ class VoteSet:
             raise VoteError(
                 f"existing vote: {existing}; new vote: {vote}: non-deterministic signature"
             )
-        # Check signature (per-vote ed25519 verify — the latency-bound hot
-        # spot in SURVEY.md §3.2; whole-commit batches go to the TPU instead).
-        vote.verify(self.chain_id, val.pub_key)
+        # Check signature. The structural checks above stay inline; the
+        # crypto rides the shared micro-batch window (crypto/sigbatch.py) so
+        # concurrent admissions — gossip from many peers, every in-process
+        # node of a devnet — merge into one columnar dispatch. Semantics are
+        # exactly vote.verify's: address binding first, then the signature,
+        # with the same VoteError messages (bit-identical to the scalar
+        # path; asserted by tests/test_vote_batch.py).
+        if val.pub_key.address() != val_addr:
+            raise VoteError("invalid validator address")
+        if not sigbatch.verify_vote_signature(
+            val.pub_key, vote.sign_bytes(self.chain_id), vote.signature
+        ):
+            raise VoteError("invalid signature")
         added, conflicting = self._add_verified_vote(vote, block_key, val.voting_power)
         if conflicting is not None:
             raise ErrVoteConflictingVotes(conflicting, vote)

@@ -51,3 +51,9 @@ def pytest_configure(config):
         "tests run in tier-1, flood-scale runs carry `slow` too — "
         "`-m ingress` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "hotpath: consensus hot-path tests (micro-batched vote admission, "
+        "WAL group commit, blocksync verify/apply pipeline); runs in "
+        "tier-1 — `-m hotpath` selects just this group",
+    )

@@ -1,0 +1,388 @@
+"""Consensus hot-path coverage (crypto/sigbatch.py + VoteSet admission +
+WAL group commit): micro-batched vote admission must be bit-identical to
+the scalar path (same accepts, rejects, and conflict errors over seeded
+shuffles), bad signatures must never poison a shared window, a chaos-wedged
+primary tier must degrade without dropping a single valid vote, and WAL
+group commit must coalesce fsyncs while preserving the frame-durable-
+before-return contract that fsync-before-processing relies on."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.crypto import ed25519, sigbatch
+from cometbft_tpu.types import BlockID, GenesisDoc, GenesisValidator, Time, Vote
+from cometbft_tpu.types.block import PRECOMMIT_TYPE
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import VoteError
+from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+
+pytestmark = pytest.mark.hotpath
+
+CHAIN = "votebatch-chain"
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+OTHER = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+
+
+def _rig(n):
+    pvs = [MockPV() for _ in range(n)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Time(1700000000, 0),
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, "") for pv in pvs
+        ],
+    )
+    gen.validate_and_complete()
+    from cometbft_tpu.state import make_genesis_state
+
+    vals = make_genesis_state(gen).validators
+    pv_by_addr = {pv.address(): pv for pv in pvs}
+    ordered = [pv_by_addr[v.address] for v in vals.validators]
+    return ordered, vals
+
+
+def _vote(pv, idx, bid, nanos=0):
+    v = Vote(
+        type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+        timestamp=Time(1700000001, nanos),
+        validator_address=pv.address(), validator_index=idx,
+    )
+    return pv.sign_vote(CHAIN, v)
+
+
+def _fresh_cache():
+    """Both arms of an A/B must start cold: the verified-triple cache is
+    process-global, and a warm cache would turn the batched arm into pure
+    dict hits (valid, but it would not exercise the dispatcher)."""
+    with ed25519._verified_lock:
+        ed25519._verified.clear()
+
+
+@pytest.fixture
+def batcher_guard():
+    """Restore the module singleton whatever a test installs."""
+    yield
+    sigbatch.reset()
+
+
+def _mixed_votes(pvs, seed):
+    """Valid votes interleaved with exact duplicates, bad signatures, and
+    conflicting (double-sign) votes, in a seeded shuffle."""
+    votes = [("valid", _vote(pv, i, BID)) for i, pv in enumerate(pvs)]
+    for i, pv in enumerate(pvs):
+        if i % 4 == 1:
+            votes.append(("dup", votes[i][1]))
+        elif i % 4 == 2:
+            votes.append(("badsig", votes[i][1].with_signature(b"\x05" * 64)))
+        elif i % 4 == 3:
+            votes.append(("conflict", _vote(pv, i, OTHER, nanos=7)))
+    random.Random(seed).shuffle(votes)
+    return votes
+
+
+def _admit_all(vs, votes):
+    out = []
+    for _, v in votes:
+        try:
+            out.append(("added", vs.add_vote(v)))
+        except ErrVoteConflictingVotes as e:
+            out.append(("conflict", e.vote_b.validator_index))
+        except VoteError as e:
+            out.append(("voteerr", str(e)))
+    return out
+
+
+def _snapshot(vs):
+    return (
+        vs.sum,
+        [v.signature if v is not None else None for v in vs.votes],
+        vs.maj23.key() if vs.maj23 is not None else None,
+        str(vs.bit_array()),
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_batched_admission_bit_identical_to_scalar(seed, batcher_guard):
+    """The same seeded vote stream, admitted in the same order, must produce
+    identical outcomes (accept/duplicate/bad-sig/conflict, with identical
+    error text) and an identical final VoteSet whether the signature check
+    runs inline scalar (window 0) or through the micro-batch dispatcher."""
+    pvs, vals = _rig(12)
+    votes = _mixed_votes(pvs, seed)
+
+    sigbatch.set_batcher(sigbatch.SigBatcher(window_ms=0))
+    _fresh_cache()
+    vs_scalar = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+    res_scalar = _admit_all(vs_scalar, votes)
+
+    b = sigbatch.SigBatcher(window_ms=2)
+    sigbatch.set_batcher(b)
+    _fresh_cache()
+    vs_batch = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+    res_batch = _admit_all(vs_batch, votes)
+
+    assert res_scalar == res_batch
+    assert _snapshot(vs_scalar) == _snapshot(vs_batch)
+    assert b.counters()["dispatches"] > 0, "batched arm never dispatched"
+
+
+def test_bad_sig_isolation_in_concurrent_window(batcher_guard):
+    """Concurrent admissions share dispatch windows ACROSS vote sets (one
+    VoteSet serializes on its own mutex — the reference's addVote locking —
+    so the sharing surface is many in-process nodes, the devnet shape).
+    Every bad signature must be rejected per-vote while every valid vote in
+    the same windows is accepted — a False lane, not a poisoned batch."""
+    n_nodes = 6
+    rigs = [_rig(4) for _ in range(n_nodes)]
+    sigbatch.set_batcher(sigbatch.SigBatcher(window_ms=5))
+    _fresh_cache()
+    outcomes = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_nodes)
+    vote_sets = []
+
+    def worker(pvs, vals):
+        vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+        with lock:
+            vote_sets.append(vs)
+        work = [(True, _vote(pv, i, BID)) for i, pv in enumerate(pvs)]
+        work += [
+            (False, _vote(pv, i, OTHER, nanos=3).with_signature(b"\x05" * 64))
+            for i, pv in enumerate(pvs)
+        ]
+        random.Random(len(vote_sets)).shuffle(work)
+        barrier.wait()
+        for expect_ok, v in work:
+            try:
+                added = vs.add_vote(v)
+                res = (expect_ok, "added", added)
+            except VoteError as e:
+                res = (expect_ok, "voteerr", str(e))
+            with lock:
+                outcomes.append(res)
+
+    threads = [threading.Thread(target=worker, args=r) for r in rigs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(outcomes) == n_nodes * 8
+    for expect_ok, kind, detail in outcomes:
+        if expect_ok:
+            assert kind == "added" and detail is True, (kind, detail)
+        else:
+            assert kind == "voteerr" and detail == "invalid signature", (kind, detail)
+    for vs in vote_sets:
+        assert vs.sum == 40, "a valid vote was dropped"
+    c = sigbatch.get_batcher().counters()
+    assert c["dispatches"] >= 1
+    assert c["batched"] > 0, "no requests ever shared a window"
+
+
+@pytest.mark.chaos
+def test_wedged_tier_degrades_without_dropping_votes(batcher_guard):
+    """Chaos composition: a fully wedged primary tier under the micro-batch
+    window must degrade to the cpu anchor with zero valid votes dropped."""
+    from cometbft_tpu.sidecar import backend as be
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.sidecar.chaos import ChaosBackend
+    from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+    chain = ResilientBackend(
+        [
+            ("tpu", ChaosBackend(CpuBackend(), "wedge:1.0:500", seed=3)),
+            ("cpu", CpuBackend()),
+        ],
+        deadline_ms=50,
+        retries=0,
+        backoff_ms=1,
+        breaker_threshold=1,
+        breaker_cooldown_ms=60000,
+        crosscheck="off",
+    )
+    be.set_backend(chain)
+    sigbatch.set_batcher(sigbatch.SigBatcher(window_ms=2))
+    _fresh_cache()
+    try:
+        pvs, vals = _rig(16)
+        vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+        votes = [_vote(pv, i, BID) for i, pv in enumerate(pvs)]
+        errs = []
+        barrier = threading.Barrier(4)
+
+        def worker(chunk):
+            barrier.wait()
+            for v in chunk:
+                try:
+                    vs.add_vote(v)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(votes[i::4],)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, f"valid votes rejected under chaos: {errs[:3]}"
+        assert vs.sum == 160, "degraded chain dropped valid votes"
+        assert chain.counters_["degraded_calls"] > 0, "anchor never engaged"
+    finally:
+        sigbatch.set_batcher(None)
+        be.set_backend(None)
+        chain.close()
+
+
+def test_duplicate_vote_evidence_rides_one_dispatch(batcher_guard):
+    """Evidence duplicate-vote checks: two signatures from one key must go
+    through a single batched dispatch, with vote.verify semantics kept."""
+    from cometbft_tpu.evidence.verify import verify_duplicate_vote
+    from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+    pvs, vals = _rig(4)
+    va = _vote(pvs[0], 0, BID)
+    vb = _vote(pvs[0], 0, OTHER, nanos=9)
+    ev = DuplicateVoteEvidence.new(va, vb, Time(1700000002, 0), vals)
+
+    b = sigbatch.SigBatcher(window_ms=2)
+    sigbatch.set_batcher(b)
+    _fresh_cache()
+    verify_duplicate_vote(ev, CHAIN, vals)
+    c = b.counters()
+    assert c["dispatches"] == 1, c
+    assert c["dispatched_sigs"] == 2, c
+
+    ev_bad = DuplicateVoteEvidence(
+        vote_a=ev.vote_a,
+        vote_b=ev.vote_b.with_signature(b"\x06" * 64),
+        total_voting_power=ev.total_voting_power,
+        validator_power=ev.validator_power,
+        timestamp=ev.timestamp,
+    )
+    with pytest.raises(VoteError, match="invalid signature"):
+        verify_duplicate_vote(ev_bad, CHAIN, vals)
+
+
+def test_scalar_verify_signature_is_cache_hit(monkeypatch):
+    """Off the batch path, a re-verification of a proven (pub, msg, sig)
+    triple must be answered by the verified-triple LRU — no crypto call."""
+    priv = ed25519.gen_priv_key_from_secret(b"scalar-lru")
+    pub = priv.pub_key()
+    msg = b"cached-scalar-verify"
+    sig = priv.sign(msg)
+    _fresh_cache()
+    assert pub.verify_signature(msg, sig)
+
+    class Boom:
+        def verify(self, *_a, **_k):
+            raise AssertionError("crypto ran despite a cached triple")
+
+    monkeypatch.setitem(ed25519._pubkey_cache, pub.bytes(), Boom())
+    monkeypatch.setattr(
+        ed25519.ed25519_pure, "verify_zip215",
+        lambda *a: (_ for _ in ()).throw(AssertionError("zip215 ran")),
+    )
+    assert pub.verify_signature(msg, sig)
+
+
+# -- WAL group commit ---------------------------------------------------------
+
+liveness = pytest.mark.liveness
+
+
+@liveness
+def test_wal_group_commit_coalesces_fsyncs(tmp_path, monkeypatch):
+    """Concurrent write_sync callers must share fsyncs (strictly fewer syncs
+    than frames), every frame must land intact, and the group_commits
+    counter must record the sharing."""
+    monkeypatch.setenv("CMTPU_WAL_GROUP_MS", "5")
+    w = WAL(str(tmp_path / "wal"))
+    syncs = []
+    orig = w.group.flush_and_sync
+
+    def counting():
+        syncs.append(time.monotonic())
+        orig()
+
+    w.group.flush_and_sync = counting
+    w.start()
+    n_threads, per = 8, 3
+    barrier = threading.Barrier(n_threads)
+
+    def writer(k):
+        barrier.wait()
+        for j in range(per):
+            w.write_sync(EndHeightMessage(100 + k * per + j))
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    total = n_threads * per
+    assert len(syncs) < total + 1, "group commit never coalesced an fsync"
+    assert w.group_commits > 0
+    heights = sorted(
+        tm.msg.height for ok, tm in w._scan_frames()
+        if ok and isinstance(tm.msg, EndHeightMessage)
+    )
+    assert heights == [0] + list(range(100, 100 + total)), "a frame was lost"
+    w.stop()
+
+
+@liveness
+def test_wal_group_commit_frame_durable_before_return(tmp_path, monkeypatch):
+    """The fsync-before-processing contract: whatever coalescing happens,
+    write_sync must not return before ITS frame is flushed to the file —
+    checked by re-reading the WAL immediately after each return while a
+    background writer keeps group windows busy."""
+    monkeypatch.setenv("CMTPU_WAL_GROUP_MS", "2")
+    w = WAL(str(tmp_path / "wal"))
+    w.start()
+    stop = threading.Event()
+
+    def noise():
+        k = 0
+        while not stop.is_set():
+            w.write_sync(EndHeightMessage(5000 + k))
+            k += 1
+
+    t = threading.Thread(target=noise, daemon=True)
+    t.start()
+    try:
+        for h in range(200, 210):
+            w.write_sync(EndHeightMessage(h))
+            heights = {
+                tm.msg.height for ok, tm in w._scan_frames()
+                if ok and isinstance(tm.msg, EndHeightMessage)
+            }
+            assert h in heights, f"write_sync returned before frame {h} was durable"
+    finally:
+        stop.set()
+        t.join(10)
+        w.stop()
+
+
+@liveness
+def test_wal_replay_restores_round_with_group_commit(tmp_path, monkeypatch):
+    """PR 4's WAL replay must behave identically with group commit armed."""
+    monkeypatch.setenv("CMTPU_WAL_GROUP_MS", "2")
+    import test_restart_under_load as rul
+
+    rul.test_wal_replay_restores_round(tmp_path)
+
+
+@liveness
+@pytest.mark.parametrize("lost_round", [0, 2])
+def test_privval_recovery_with_group_commit(tmp_path, monkeypatch, lost_round):
+    """PR 4's privval-ahead-of-WAL recovery must survive group commit."""
+    monkeypatch.setenv("CMTPU_WAL_GROUP_MS", "2")
+    import test_restart_under_load as rul
+
+    rul.test_privval_vote_recovered_when_wal_lost_it(tmp_path, lost_round)
