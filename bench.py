@@ -1148,6 +1148,192 @@ def _hotpath_stage(stages: dict, plog) -> None:
     )
 
 
+def _lightgw_stage(stages: dict, plog) -> None:
+    """Light-client gateway (ISSUE 7): N concurrent light clients sync the
+    same span, independent bisections vs one shared gateway.
+
+    Arm A (the pre-gateway world): N clients bisect serially, each with a
+    cold verified-triple cache — every client re-pays every hop's
+    dispatch.  Arm B: the same N clients swarm a shared LightGateway whose
+    descent plan is computed once and whose hop verifications land in the
+    coalescing scheduler; the clients' mandatory re-verification then hits
+    the warm shared cache.  Both arms run the same host-MSM backend
+    wrapped with a fixed per-dispatch latency
+    (CMTPU_BENCH_LIGHTGW_DISPATCH_MS, default 20 — labeled in the JSON;
+    0 measures raw host coalescing).  The stage also reports the cold-sync
+    story: the MMR inclusion-proof wire size (`lightgw_proof_bytes`,
+    client-verified) vs shipping every block the bisection trace touches."""
+    import threading as _threading
+
+    from cometbft_tpu.crypto import ed25519 as _ed
+    from cometbft_tpu.libs.db import MemDB
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.gateway import LightGateway
+    from cometbft_tpu.light.mmr import verify_inclusion
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.sidecar import backend as _be
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+    from cometbft_tpu.types import Time as _Time
+
+    n_clients = int(os.environ.get("CMTPU_BENCH_LIGHTGW_CLIENTS", "8"))
+    height = int(os.environ.get("CMTPU_BENCH_LIGHTGW_HEIGHT", "120"))
+    dispatch_ms = float(os.environ.get("CMTPU_BENCH_LIGHTGW_DISPATCH_MS", "20"))
+
+    # 32-validator sets rotating 1/height: a 1 -> height jump dilutes trust
+    # below 1/3 within ~22 heights, forcing a real multi-hop descent while
+    # the lazily-signed fixture stays far cheaper than the 4,096-val
+    # light_bisection stage.
+    chain = _LazyChain(n_vals=32, rotate=1, heights=height)
+    lb1 = chain.light_block(1)
+    now = lambda: _Time(1700000000 + 10 * height + 600, 0)
+    opts = TrustOptions(
+        period_ns=365 * 24 * 3600 * 10**9, height=1, hash=lb1.hash()
+    )
+
+    def _fresh_client(gateway=None):
+        return Client(
+            chain.CHAIN_ID, opts, chain.provider(), [], LightStore(MemDB()),
+            gateway=gateway, gateway_proofs=False,
+        )
+
+    class _DispatchLatency:
+        """CpuBackend plus the fixed per-dispatch cost a device pays."""
+
+        name = "latency"
+
+        def __init__(self):
+            self._cpu = CpuBackend()
+            self.calls = 0
+
+        def batch_verify(self, pubs, msgs, sigs_):
+            self.calls += 1
+            if dispatch_ms > 0:
+                time.sleep(dispatch_ms / 1000.0)
+            return self._cpu.batch_verify(pubs, msgs, sigs_)
+
+        def merkle_root(self, leaves):
+            return self._cpu.merkle_root(leaves)
+
+    # Materialize the fixture blocks (provider-side OpenSSL signing cost,
+    # not client cost) and record the bisection trace for the byte count.
+    warm = _fresh_client()
+    lb = warm.verify_light_block_at_height(height, now=now())
+    assert lb.height == height
+    trace_heights = sorted(warm.store._heights())
+    bisection_bytes = sum(
+        len(chain.light_block(h).encode()) for h in trace_heights
+    )
+    plog(
+        f"lightgw fixture built ({chain.built} headers, "
+        f"{len(trace_heights)}-hop trace)"
+    )
+
+    old_backend = _be._backend
+    try:
+        # -- arm A: N independent bisections, serialized cold clients --
+        lat = _DispatchLatency()
+        _be.set_backend(lat)
+        solo_ms = []
+        for _ in range(n_clients):
+            _ed._verified.clear()
+            t0 = time.perf_counter()
+            assert _fresh_client().verify_light_block_at_height(
+                height, now=now()
+            ).height == height
+            solo_ms.append((time.perf_counter() - t0) * 1000)
+        serialized_ms = sum(solo_ms)
+
+        # -- arm B: shared gateway, coalesced dispatch, one warm cache --
+        lat2 = _DispatchLatency()
+        sched = CoalescingScheduler(lat2, window_ms=5.0)
+        _be.set_backend(sched)
+        _ed._verified.clear()
+        gw = LightGateway(chain.CHAIN_ID, chain.provider())
+        swarm_ms: list = [0.0] * n_clients
+        errors: list = []
+        start = _threading.Barrier(n_clients + 1)
+
+        def _sync(i):
+            try:
+                start.wait()
+                t0 = time.perf_counter()
+                c = _fresh_client(gateway=gw)
+                assert c.verify_light_block_at_height(
+                    height, now=now()
+                ).height == height
+                swarm_ms[i] = (time.perf_counter() - t0) * 1000
+                if c.gateway_stats["fallbacks"]:
+                    errors.append(RuntimeError("gateway fallback in bench"))
+            except Exception as e:  # pragma: no cover - stage must report
+                errors.append(e)
+
+        threads = [
+            _threading.Thread(target=_sync, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(300.0)
+        swarm_wall_ms = (time.perf_counter() - t0) * 1000
+        for i, t in enumerate(threads):
+            if t.is_alive():
+                # A hung client would leave swarm_ms[i] at 0.0 and skew
+                # swarm_p95/speedup — fail the stage loudly instead.
+                errors.append(RuntimeError(
+                    f"lightgw swarm client {i} still running after 300s join"
+                ))
+        if errors:
+            raise errors[0]
+        c = sched.counters()
+        sched.close()
+
+        # -- cold sync: one MMR proof instead of the whole trace --
+        proof = gw.prove(height, anchor_height=1)
+        verify_inclusion(
+            proof["root"], proof["size"], height - 1,
+            proof["target"]["aunts"], proof["light_block"].hash(),
+        )
+        verify_inclusion(
+            proof["root"], proof["size"], 0, proof["anchor"]["aunts"],
+            lb1.hash(),
+        )
+
+        p95 = lambda xs: sorted(xs)[max(0, int(0.95 * (len(xs) - 1)))]
+        gw_stats = gw.stats()
+        stages["lightgw"] = {
+            "clients": n_clients,
+            "height": height,
+            "trace_hops": len(trace_heights),
+            "simulated_dispatch_ms": dispatch_ms,
+            "serialized_ms": round(serialized_ms, 2),
+            "swarm_wall_ms": round(swarm_wall_ms, 2),
+            "speedup": round(serialized_ms / max(swarm_wall_ms, 1e-9), 2),
+            "solo_p95_ms": round(p95(solo_ms), 2),
+            "swarm_p95_ms": round(p95(swarm_ms), 2),
+            "serialized_dispatches": lat.calls,
+            "swarm_dispatches": lat2.calls,
+            "coalesce_ratio": c["coalesce_ratio"],
+            "plan_misses": gw_stats["plan_misses"],
+            "plan_shared": gw_stats["plan_hits"] + gw_stats["plan_waits"],
+            "lightgw_proof_bytes": proof["bytes"],
+            "bisection_bytes": bisection_bytes,
+            "proof_bytes_ratio": round(bisection_bytes / proof["bytes"], 1),
+        }
+        plog(
+            f"lightgw: {n_clients} clients to {height}: serialized "
+            f"{serialized_ms:.0f} ms -> swarm {swarm_wall_ms:.0f} ms "
+            f"({stages['lightgw']['speedup']}x, {lat2.calls} dispatches); "
+            f"cold proof {proof['bytes']} B vs {bisection_bytes} B "
+            f"({stages['lightgw']['proof_bytes_ratio']}x)"
+        )
+    finally:
+        _ed._verified.clear()
+        _be.set_backend(old_backend)
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -1231,6 +1417,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _hotpath_stage(stages, plog)
         except Exception as e:
             plog(f"hotpath stage failed: {type(e).__name__}: {e}")
+
+    # ---- light gateway: shared-plan swarm vs independent bisections ----
+    if budget_left():
+        try:
+            _lightgw_stage(stages, plog)
+        except Exception as e:
+            plog(f"lightgw stage failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":

@@ -64,6 +64,7 @@ ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
+    "light_gateway",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -199,6 +200,9 @@ class E2ERunner:
         # Per-node results of the concurrent_light_clients perturbation
         # (swarm agreement + the runner-process coalesce counter deltas).
         self._light_swarms: dict[str, dict] = {}
+        # Per-node results of the light_gateway perturbation (cold-sync
+        # swarm against the node's MMR proof path).
+        self._light_gateways: dict[str, dict] = {}
         # Nodes relaunched with per-sender ingress rate limiting armed, and
         # the per-node results of the tx_flood perturbation.
         self._flood_armed: set[str] = set()
@@ -529,6 +533,13 @@ class E2ERunner:
             # scheduler, which must merge them into shared dispatches while
             # every swarm member still converges on the same hash.
             self._light_swarms[name] = self._light_client_swarm(node)
+        elif kind == "light_gateway":
+            # Cold-sync swarm against the node's MMR proof path: every
+            # client starts from a genesis-adjacent trust anchor and syncs
+            # to the tip through light_proof instead of bisecting, then the
+            # result hash must agree with a plain local bisection.  No
+            # process disruption here either.
+            self._light_gateways[name] = self._light_gateway_swarm(node)
         elif kind == "disconnect":
             pid = proc.pid
             t_end = time.time() + 4.0
@@ -667,16 +678,34 @@ class E2ERunner:
             return None
         return {k: v for k, v in b.counters().items() if isinstance(v, int)}
 
+    def _gateway_stats(self, url: str) -> dict | None:
+        """The node's light_gateway_stats counters, or None when the
+        gateway is disabled on that node (CMTPU_LIGHTGW=0)."""
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        try:
+            st = HTTPClient(url, timeout=5).call("light_gateway_stats")
+        except Exception:
+            return None
+        if not st.get("enabled"):
+            return None
+        return {k: v for k, v in st.items() if isinstance(v, (int, float))}
+
     def _light_client_swarm(self, node: ManifestNode, n_clients: int = 4) -> dict:
         """N skipping-mode light clients bisect against `node` at once.
 
         The swarm's commit verifications all land in this (runner)
         process's verification backend, so concurrent bisections should
-        coalesce into shared dispatches.  Every member must converge on
-        the same hash; the returned dict carries the swarm result plus the
-        scheduler counter deltas attributable to the swarm."""
+        coalesce into shared dispatches.  When the node serves the light
+        gateway the clients sync gateway-assisted (plan mode: the shared
+        descent plan is fetched once and re-verified locally by everyone)
+        and the node-side gateway counter deltas ride the report.  Every
+        member must converge on the same hash; the returned dict carries
+        the swarm result plus the scheduler counter deltas attributable to
+        the swarm."""
         from cometbft_tpu.libs.db import MemDB
         from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.gateway import RemoteGateway
         from cometbft_tpu.light.provider import HTTPProvider
         from cometbft_tpu.light.store import LightStore
         from cometbft_tpu.rpc.client import HTTPClient
@@ -692,19 +721,25 @@ class E2ERunner:
             hash=bytes.fromhex(blk["block_id"]["hash"]),
         )
         before = self._coalesce_counters() or {}
+        gw_before = self._gateway_stats(url)
         results: list = [None] * n_clients
         barrier = threading.Barrier(n_clients)
 
         def bisect(i: int) -> None:
             try:
                 barrier.wait(timeout=30)
+                gateway = None
+                if gw_before is not None:
+                    gateway = RemoteGateway(HTTPClient(url, timeout=5))
                 client = Client(
                     "e2e-manifest", trust,
                     HTTPProvider("e2e-manifest", HTTPClient(url, timeout=5)),
                     [], LightStore(MemDB()),
+                    gateway=gateway, gateway_proofs=False,
                 )
                 lb = client.verify_light_block_at_height(target, cmttime.now())
-                results[i] = ("ok", lb.hash().hex().upper())
+                results[i] = ("ok", lb.hash().hex().upper(),
+                              dict(client.gateway_stats))
             except Exception as exc:  # surfaced by the agreement check
                 results[i] = ("error", repr(exc))
 
@@ -733,6 +768,130 @@ class E2ERunner:
                 round(delta.get("requests", 0) / disp, 3) if disp else 0.0
             )
             out["coalesce"] = delta
+        if gw_before is not None:
+            gw_after = self._gateway_stats(url) or {}
+            out["gateway"] = {
+                k: round(v - gw_before.get(k, 0), 3)
+                for k, v in gw_after.items()
+                if k in ("sessions_total", "plan_hits", "plan_misses",
+                         "plan_waits", "prewarmed_sigs")
+            }
+            out["gateway"]["plan_syncs"] = sum(
+                r[2]["plan_syncs"] for r in results
+            )
+            out["gateway"]["fallbacks"] = sum(
+                r[2]["fallbacks"] for r in results
+            )
+            if out["gateway"]["plan_syncs"] == 0:
+                # Hash agreement alone would pass even if every client
+                # fell back to a plain bisection — the perturbation exists
+                # to exercise the gateway path, so never-took-it fails.
+                raise AssertionError(
+                    f"{name}: gateway armed but no client synced via the "
+                    f"plan path: {out['gateway']}"
+                )
+        return out
+
+    def _light_gateway_swarm(self, node: ManifestNode, n_clients: int = 4) -> dict:
+        """Cold-sync swarm against `node`'s MMR proof path: every client
+        trusts height 1 and jumps straight to the tip via light_proof
+        (O(log n) accumulator proof + one commit verification), and the
+        resulting hash must agree with a plain local bisection run after
+        the swarm.  A gateway-disabled node fails loudly — this
+        perturbation only appears in manifests that arm the gateway."""
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.gateway import RemoteGateway
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.light.store import LightStore
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types import cmttime
+
+        name = node.name
+        url = f"http://127.0.0.1:{self.rpc_ports[name]}"
+        gw_before = self._gateway_stats(url)
+        if gw_before is None:
+            raise AssertionError(
+                f"{name}: light_gateway perturbation but gateway disabled"
+            )
+        target = max(2, self._height(name))
+        blk = HTTPClient(url, timeout=5).block(1)
+        trust = TrustOptions(
+            period_ns=int(3600 * 10**9),
+            height=1,
+            hash=bytes.fromhex(blk["block_id"]["hash"]),
+        )
+        results: list = [None] * n_clients
+        barrier = threading.Barrier(n_clients)
+
+        def cold_sync(i: int) -> None:
+            try:
+                barrier.wait(timeout=30)
+                client = Client(
+                    "e2e-manifest", trust,
+                    HTTPProvider("e2e-manifest", HTTPClient(url, timeout=5)),
+                    [], LightStore(MemDB()),
+                    gateway=RemoteGateway(HTTPClient(url, timeout=5)),
+                    gateway_proofs=True,
+                )
+                lb = client.verify_light_block_at_height(target, cmttime.now())
+                results[i] = ("ok", lb.hash().hex().upper(),
+                              dict(client.gateway_stats))
+            except Exception as exc:
+                results[i] = ("error", repr(exc))
+
+        threads = [
+            threading.Thread(target=cold_sync, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        bad = [r for r in results if r is None or r[0] != "ok"]
+        if bad:
+            raise AssertionError(f"{name}: gateway cold-sync failures: {bad}")
+        hashes = {r[1] for r in results}
+        if len(hashes) != 1:
+            raise AssertionError(
+                f"{name}: gateway cold-sync hash disagreement: {hashes}"
+            )
+        # Reference arm: the same sync, gateway-less — the MMR shortcut
+        # must land on the bit-identical header.
+        local = Client(
+            "e2e-manifest", trust,
+            HTTPProvider("e2e-manifest", HTTPClient(url, timeout=5)),
+            [], LightStore(MemDB()),
+        )
+        local_hash = local.verify_light_block_at_height(
+            target, cmttime.now()
+        ).hash().hex().upper()
+        agreed = hashes.pop()
+        if local_hash != agreed:
+            raise AssertionError(
+                f"{name}: gateway vs local hash mismatch at {target}: "
+                f"{agreed} vs {local_hash}"
+            )
+        gw_after = self._gateway_stats(url) or {}
+        out = {
+            "clients": n_clients,
+            "height": target,
+            "hash": agreed,
+            "proof_syncs": sum(r[2]["proof_syncs"] for r in results),
+            "proof_rejects": sum(r[2]["proof_rejects"] for r in results),
+            "fallbacks": sum(r[2]["fallbacks"] for r in results),
+            "proof_bytes": sum(r[2]["proof_bytes"] for r in results),
+            "gateway": {
+                k: round(v - gw_before.get(k, 0), 3)
+                for k, v in gw_after.items()
+                if k in ("sessions_total", "proofs_served", "proof_bytes",
+                         "mmr_size")
+            },
+        }
+        if out["proof_syncs"] == 0:
+            raise AssertionError(
+                f"{name}: cold-sync swarm never took the proof path: {out}"
+            )
         return out
 
     def _vote_batch_check(self, name: str, after_height: int) -> dict:
@@ -971,6 +1130,8 @@ class E2ERunner:
                 report["backend_faults"] = sorted(self._fault_armed)
             if self._light_swarms:
                 report["concurrent_light_clients"] = self._light_swarms
+            if self._light_gateways:
+                report["light_gateway"] = self._light_gateways
             if self._tx_floods:
                 report["tx_flood"] = self._tx_floods
             if self._vote_batches:

@@ -9,7 +9,14 @@
 """
 
 from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.gateway import (
+    GatewayError,
+    LightGateway,
+    RemoteGateway,
+)
+from cometbft_tpu.light.mmr import MMR
 from cometbft_tpu.light.provider import (
+    BlockStoreProvider,
     ErrLightBlockNotFound,
     ErrNoResponse,
     HTTPProvider,
@@ -25,7 +32,12 @@ __all__ = [
     "Provider",
     "MockProvider",
     "HTTPProvider",
+    "BlockStoreProvider",
     "LightStore",
+    "LightGateway",
+    "RemoteGateway",
+    "GatewayError",
+    "MMR",
     "verifier",
     "ErrLightBlockNotFound",
     "ErrNoResponse",

@@ -66,6 +66,8 @@ class Client:
         max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         skip_verification: str = "skipping",  # or "sequential"
+        gateway=None,  # LightGateway / RemoteGateway: untrusted accelerator
+        gateway_proofs: bool | None = None,  # try the MMR proof path first
         logger=None,
     ):
         verifier.validate_trust_level(trust_level)
@@ -80,9 +82,24 @@ class Client:
         self.store = store
         self.pruning_size = pruning_size
         self.mode = skip_verification
+        self.gateway = gateway
+        if gateway_proofs is None:
+            from cometbft_tpu.light.gateway import proof_mode
+
+            gateway_proofs = proof_mode() == "mmr"
+        self.gateway_proofs = gateway_proofs
         self.logger = logger
         # Speculative-bisection counters (bench/e2e observability).
         self.speculation = {"descents": 0, "prewarmed_sigs": 0}
+        # Gateway-assisted sync counters: which path served each forward
+        # verification, and what a rejected/unavailable gateway cost.
+        self.gateway_stats = {
+            "plan_syncs": 0,
+            "proof_syncs": 0,
+            "proof_rejects": 0,
+            "fallbacks": 0,
+            "proof_bytes": 0,
+        }
         self._init_trust(trust_options)
 
     # -- initialization (client.go:266-360) -----------------------------------
@@ -154,6 +171,8 @@ class Client:
         if new_lb.height > trusted.height:
             if self.mode == "sequential":
                 trace = self._verify_sequential(trusted, new_lb, now)
+            elif self.gateway is not None:
+                trace = self._verify_with_gateway(trusted, new_lb, now)
             else:
                 trace = self._verify_skipping(trusted, new_lb, now)
             for lb in trace:
@@ -279,6 +298,110 @@ class Client:
         except Exception:
             pass
 
+    # -- gateway-assisted sync (light/gateway.py; untrusted accelerator) ------
+
+    def _verify_with_gateway(self, trusted: LightBlock, target: LightBlock,
+                             now: Time):
+        """Gateway-assisted forward verification with guaranteed fallback.
+
+        Proof mode first (when enabled): O(log n) MMR inclusion proofs
+        binding the gateway's history to both our trust anchor and the
+        target, plus the standard one-hop trust check of the target
+        against OUR trusted validator set — rejected proofs NEVER degrade
+        the decision, they only cost the fallback.
+        Plan mode next: the gateway's memoized descent plan prefetches the
+        pivots and prewarms the shared verified-triple cache, then the
+        bit-identical local _verify_skipping walk re-verifies every hop
+        (a poisoned plan block fails that walk and we fall back to the
+        real primary).  Any gateway failure -> plain local bisection."""
+        if self.gateway_proofs:
+            try:
+                return self._verify_gateway_proof(trusted, target, now)
+            except Exception as e:
+                self.gateway_stats["proof_rejects"] += 1
+                if self.logger:
+                    self.logger.info(
+                        "gateway proof rejected; falling back",
+                        module="light", err=repr(e),
+                    )
+        try:
+            plan = self.gateway.sync_plan(trusted.height, target.height, now)
+            by_height = {}
+            for lb in plan:
+                lb.validate_basic(self.chain_id)
+                by_height[lb.height] = lb
+            # The gateway's copy of the target must BE our target — the
+            # decision object stays the one our primary handed us.
+            if target.height in by_height and \
+                    by_height[target.height].hash() != target.hash():
+                raise ValueError("gateway plan disagrees on target header")
+            old_primary = self.primary
+            self.primary = _PlanProvider(self.chain_id, by_height, old_primary)
+            try:
+                trace = self._verify_skipping(trusted, target, now)
+            finally:
+                self.primary = old_primary
+            self.gateway_stats["plan_syncs"] += 1
+            return trace
+        except Exception as e:
+            self.gateway_stats["fallbacks"] += 1
+            if self.logger:
+                self.logger.info(
+                    "gateway sync failed; local bisection",
+                    module="light", err=repr(e),
+                )
+            return self._verify_skipping(trusted, target, now)
+
+    def _verify_gateway_proof(self, trusted: LightBlock, target: LightBlock,
+                              now: Time):
+        """Cold-sync acceptance = the standard one-hop verification
+        (verifier.verify: trusting-overlap against OUR trusted validator
+        set, then the target's own +2/3 commit) PLUS accumulator
+        membership: both our trust anchor and the target must prove into
+        ONE gateway root.  Inclusion under a gateway-supplied root is
+        history-binding, never trust — it can only narrow acceptance, so
+        a gateway forging a self-signed history proves inclusion of
+        garbage and still dies on the trusted-set overlap.  Everything is
+        re-derived client-side from the response; any failure (including
+        ErrNewValSetCantBeTrusted when rotation diluted the anchor's
+        overlap) raises and the caller falls back to plan mode, whose
+        walk bisects."""
+        from cometbft_tpu.light.mmr import verify_inclusion
+
+        if verifier.header_expired(trusted.signed_header,
+                                   self.trusting_period_ns, now):
+            raise verifier.ErrOldHeaderExpired(
+                trusted.signed_header.header.time.add_nanos(
+                    self.trusting_period_ns
+                ),
+                now,
+            )
+        resp = self.gateway.prove(target.height, anchor_height=trusted.height)
+        size, root = int(resp["size"]), resp["root"]
+        anchor = resp.get("anchor")
+        if anchor is None:
+            raise ValueError("gateway proof lacks the trust-anchor branch")
+        if int(resp["target"]["index"]) != target.height - 1 or \
+                int(anchor["index"]) != trusted.height - 1:
+            raise ValueError("gateway proof indexes do not match heights")
+        verify_inclusion(root, size, trusted.height - 1, anchor["aunts"],
+                         trusted.hash())
+        verify_inclusion(root, size, target.height - 1,
+                         resp["target"]["aunts"], target.hash())
+        verifier.verify(
+            trusted.signed_header,
+            trusted.validator_set,
+            target.signed_header,
+            target.validator_set,
+            self.trusting_period_ns,
+            now,
+            self.max_clock_drift_ns,
+            self.trust_level,
+        )
+        self.gateway_stats["proof_syncs"] += 1
+        self.gateway_stats["proof_bytes"] += int(resp.get("bytes", 0))
+        return [target]
+
     def _verify_backwards(self, target: LightBlock) -> None:
         """client.go backwards: hash-chain from the earliest trusted header."""
         first_h = self.store.first_light_block_height()
@@ -306,6 +429,29 @@ class Client:
 
     def remove_witness(self, witness: Provider) -> None:
         self.witnesses = [w for w in self.witnesses if w is not witness]
+
+
+class _PlanProvider(Provider):
+    """Primary wrapper for one gateway-assisted descent: pivots named by
+    the plan are served from memory, anything else (a plan that guessed
+    wrong, latest-height probes) falls through to the real primary — so a
+    stale or partial plan degrades to extra fetches, never to a different
+    verification outcome."""
+
+    def __init__(self, chain_id: str, blocks: dict[int, LightBlock], primary):
+        self._chain_id = chain_id
+        self._blocks = blocks
+        self._primary = primary
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        lb = self._blocks.get(height) if height else None
+        return lb if lb is not None else self._primary.light_block(height)
+
+    def report_evidence(self, ev) -> None:
+        self._primary.report_evidence(ev)
 
 
 def random_witness_order(n: int) -> list[int]:

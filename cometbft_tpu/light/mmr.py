@@ -1,0 +1,129 @@
+"""Merkle Mountain Range over committed headers (light-client gateway).
+
+Append-only accumulator in the style of "The Merkle Mountain Belt"
+(arXiv:2511.13582): leaves are appended one committed header hash at a
+time and the structure keeps every perfect-subtree node, so an inclusion
+proof for any past leaf under the latest peak set is produced in
+O(log^2 n) hashes WITHOUT rehashing the history.
+
+RFC-6962 compatibility is exact, not "in spirit": leaves and inner nodes
+use crypto/merkle's domain-separated `leaf_hash` / `inner_hash`, peaks
+are bagged right-to-left, and — because bagging the binary-decomposition
+peaks right-to-left is literally the `get_split_point` recursion of
+crypto/merkle/tree.py — `MMR.root()` equals `hash_from_byte_slices(leaves)`
+and `MMR.prove(i)` emits a standard `crypto.merkle.proof.Proof` whose
+aunts are identical to `proofs_from_byte_slices(leaves)[1][i]`.  A cold
+light client therefore verifies a gateway proof with the existing Proof
+machinery; nothing new to trust in the verifier.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.crypto.merkle.hash import empty_hash, inner_hash, leaf_hash
+from cometbft_tpu.crypto.merkle.proof import Proof
+from cometbft_tpu.crypto.merkle.tree import get_split_point
+
+
+class MMR:
+    """Append-only RFC-6962 Merkle tree with O(1) amortized append.
+
+    `_levels[k][j]` is the root of the perfect subtree over leaves
+    [j * 2^k, (j+1) * 2^k) — only complete pairs are merged, so level k
+    holds floor(n / 2^k) nodes and the peaks of the range are the
+    right-most node of each level where the binary digit of n is set.
+    """
+
+    def __init__(self) -> None:
+        self._levels: list[list[bytes]] = [[]]
+
+    def __len__(self) -> int:
+        return len(self._levels[0])
+
+    @property
+    def size(self) -> int:
+        return len(self._levels[0])
+
+    def append(self, data: bytes) -> int:
+        """Append one leaf (raw bytes, e.g. a 32-byte header hash); returns
+        its 0-based leaf index."""
+        idx = len(self._levels[0])
+        self._levels[0].append(leaf_hash(data))
+        k = 0
+        # Merge complete pairs upward: after appending leaf idx, level k
+        # gains a node whenever 2^(k+1) divides into the filled prefix.
+        while len(self._levels[k]) % 2 == 0 and len(self._levels[k]) > 0:
+            if len(self._levels) == k + 1:
+                self._levels.append([])
+            lvl = self._levels[k]
+            self._levels[k + 1].append(inner_hash(lvl[-2], lvl[-1]))
+            k += 1
+        return idx
+
+    def peaks(self) -> list[tuple[int, bytes]]:
+        """[(subtree_size, peak_hash)] left-to-right — the binary
+        decomposition of `size`, largest peak first."""
+        n = self.size
+        out: list[tuple[int, bytes]] = []
+        consumed = 0
+        for k in range(n.bit_length() - 1, -1, -1):
+            if n & (1 << k):
+                out.append((1 << k, self._levels[k][consumed >> k]))
+                consumed += 1 << k
+        return out
+
+    def root(self) -> bytes:
+        """Peaks bagged right-to-left == RFC-6962 root of the leaf list."""
+        pk = self.peaks()
+        if not pk:
+            return empty_hash()
+        h = pk[-1][1]
+        for _, p in reversed(pk[:-1]):
+            h = inner_hash(p, h)
+        return h
+
+    def _range_root(self, start: int, count: int) -> bytes:
+        """Root of leaves [start, start+count).  A stored node when the
+        range is an aligned perfect subtree; otherwise the split-point
+        recursion over stored nodes (only the right spine is imperfect,
+        so this is O(log n) hashes)."""
+        if count & (count - 1) == 0 and start % count == 0:
+            k = count.bit_length() - 1
+            return self._levels[k][start >> k]
+        k = get_split_point(count)
+        return inner_hash(
+            self._range_root(start, k), self._range_root(start + k, count - k)
+        )
+
+    def prove(self, index: int) -> Proof:
+        """Inclusion proof for leaf `index` under the current root —
+        bit-identical to proofs_from_byte_slices' audit path."""
+        n = self.size
+        if not 0 <= index < n:
+            raise IndexError(f"leaf {index} not in MMR of size {n}")
+        spans: list[tuple[int, int]] = []
+        start, count, i = 0, n, index
+        while count > 1:
+            k = get_split_point(count)
+            if i < k:
+                spans.append((start + k, count - k))
+                count = k
+            else:
+                spans.append((start, k))
+                start += k
+                i -= k
+                count -= k
+        # Aunts are ordered leaf-sibling first (proof.go contract); the
+        # walk above collected them root-side first.
+        resolved = [self._range_root(s, c) for s, c in reversed(spans)]
+        return Proof(
+            total=n, index=index, leaf_hash=self._levels[0][index], aunts=resolved
+        )
+
+
+def verify_inclusion(root: bytes, total: int, index: int, aunts: list[bytes],
+                     data: bytes) -> None:
+    """Check that `data` is the leaf at `index` of the `total`-leaf tree
+    with `root` — raises ValueError otherwise.  Pure function over the
+    existing Proof verifier, for callers holding a wire-decoded proof."""
+    Proof(total=total, index=index, leaf_hash=leaf_hash(data),
+          aunts=list(aunts)).verify(root, data)

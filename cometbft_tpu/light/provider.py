@@ -58,6 +58,54 @@ class MockProvider(Provider):
         self.evidences.append(ev)
 
 
+class BlockStoreProvider(Provider):
+    """Node-local provider over the block + state stores — the light
+    gateway's source when it runs inside a node (no RPC round trip, no
+    JSON re-encode).  Commit selection mirrors the /commit route: the tip
+    serves its seen commit, history serves the canonical block commit."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self._block_store = block_store
+        self._state_store = state_store
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def base_height(self) -> int:
+        """Lowest retained height — the gateway refuses MMR proof serving
+        when the store is pruned above 1 (leaf index = height - 1)."""
+        return self._block_store.base()
+
+    def header_hash(self, height: int) -> bytes | None:
+        """Header hash without materializing the validator set (the MMR
+        append path touches every height once)."""
+        meta = self._block_store.load_block_meta(height)
+        return meta.header.hash() if meta is not None else None
+
+    def light_block(self, height: int) -> LightBlock:
+        tip = self._block_store.height()
+        h = height if height > 0 else tip
+        meta = self._block_store.load_block_meta(h)
+        if meta is None:
+            raise ErrLightBlockNotFound(f"no block meta at height {h}")
+        if h == tip:
+            commit = self._block_store.load_seen_commit(h)
+        else:
+            commit = self._block_store.load_block_commit(h)
+        if commit is None:
+            raise ErrLightBlockNotFound(f"no commit at height {h}")
+        vals = self._state_store.load_validators(h)
+        if vals is None:
+            raise ErrLightBlockNotFound(f"no validators at height {h}")
+        return LightBlock(
+            signed_header=SignedHeader(meta.header, commit), validator_set=vals
+        )
+
+    def report_evidence(self, ev) -> None:
+        pass  # a node-local source has nowhere meaningful to forward this
+
+
 class HTTPProvider(Provider):
     """light/provider/http/http.go: LightBlocks from a node's RPC."""
 

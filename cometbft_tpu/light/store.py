@@ -5,6 +5,7 @@ height queries and pruning."""
 
 from __future__ import annotations
 
+import os
 import struct
 
 from cometbft_tpu.libs.db import DB
@@ -12,6 +13,7 @@ from cometbft_tpu.types.light_block import LightBlock
 
 _PREFIX = b"lb/"
 _SIZE_KEY = b"lb_size"
+DEFAULT_CACHE_BLOCKS = 16
 
 
 def _key(height: int) -> bytes:
@@ -25,16 +27,31 @@ class LightStore:
     # every verify, bisection re-reads). Decoding a 4k-validator block is
     # ~100 ms of pure-python proto work, so a small write-through object
     # cache in front of the DB pays for itself on the first hit. The DB
-    # stays the source of truth; the cache only ever mirrors it.
-    _CACHE_BLOCKS = 16
+    # stays the source of truth; the cache only ever mirrors it.  The cap
+    # is an LRU with refresh-on-reput (the CMTPU_VERIFY_CACHE_MAX
+    # semantics): CMTPU_LIGHT_STORE_CACHE or the cache_blocks kwarg —
+    # gateway-fronted stores serving many clients want it above the
+    # default 16.
 
-    def __init__(self, db: DB):
+    def __init__(self, db: DB, cache_blocks: int | None = None):
+        if cache_blocks is None:
+            try:
+                cache_blocks = int(
+                    os.environ.get(
+                        "CMTPU_LIGHT_STORE_CACHE", str(DEFAULT_CACHE_BLOCKS)
+                    )
+                )
+            except ValueError:
+                cache_blocks = DEFAULT_CACHE_BLOCKS
+        self._cache_blocks = max(1, cache_blocks)
         self._db = db
         self._cache: dict[int, LightBlock] = {}
 
     def _cache_put(self, lb: LightBlock) -> None:
+        # Delete + reinsert moves the height to the young end; evict from
+        # the old end past the cap (insertion-ordered dict as LRU).
         self._cache.pop(lb.height, None)
-        while len(self._cache) >= self._CACHE_BLOCKS:
+        while len(self._cache) >= self._cache_blocks:
             self._cache.pop(next(iter(self._cache)))
         self._cache[lb.height] = lb
 

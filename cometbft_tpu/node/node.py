@@ -177,6 +177,7 @@ class Node:
                            lambda: self.block_store.base())
             self._register_backend_metrics(reg)
             self._register_hotpath_metrics(reg)
+            self._register_lightgw_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
             host, _, port = addr.rpartition(":")
             self.metrics_server = MetricsServer(
@@ -305,6 +306,37 @@ class Node:
         self.grpc_server = None
         self._rpc_env = None
 
+        # Light-client gateway (light/gateway.py): built on first
+        # light_sync/light_proof RPC, never at boot — the lazy accessor is
+        # what the RPC env carries and the metrics gauges deliberately
+        # bypass (they read _light_gateway directly, so a scrape never
+        # constructs it).
+        self._light_gateway = None
+        self._light_gateway_lock = threading.Lock()
+
+    def light_gateway(self):
+        """The node's LightGateway over its local stores; None when
+        CMTPU_LIGHTGW=0 disables serving."""
+        if os.environ.get("CMTPU_LIGHTGW", "1").strip().lower() in (
+            "0", "false", "off",
+        ):
+            return None
+        with self._light_gateway_lock:
+            if self._light_gateway is None:
+                from cometbft_tpu.light.gateway import LightGateway
+                from cometbft_tpu.light.provider import BlockStoreProvider
+
+                self._light_gateway = LightGateway(
+                    self.genesis_doc.chain_id,
+                    BlockStoreProvider(
+                        self.genesis_doc.chain_id,
+                        self.block_store,
+                        self.state_store,
+                    ),
+                    logger=self.logger,
+                )
+            return self._light_gateway
+
     @staticmethod
     def _register_backend_metrics(reg) -> None:
         """backend_trips / backend_retries / backend_deadline_exceeded /
@@ -417,6 +449,45 @@ class Node:
                            getattr(self, "blocksync_reactor", None),
                            "pipeline_overlap_ms", 0) or 0))
 
+    def _register_lightgw_metrics(self, reg) -> None:
+        """Light-client gateway gauges. Strictly passive: they read the
+        `_light_gateway` attribute (getattr-guarded — registration runs
+        before __init__ assigns it) and never call the light_gateway()
+        accessor, so a metrics scrape can never construct the gateway."""
+
+        def gw(key):
+            def fn():
+                g = getattr(self, "_light_gateway", None)
+                if g is None:
+                    return 0
+                return int(g.stats().get(key, 0))
+            return fn
+
+        def gw_share_milli():
+            g = getattr(self, "_light_gateway", None)
+            if g is None:
+                return 0
+            return int(1000 * g.stats()["plan_share_ratio"])
+
+        reg.gauge_func("lightgw", "sessions_total",
+                       "Light-gateway sync sessions admitted.",
+                       gw("sessions_total"))
+        reg.gauge_func("lightgw", "sessions_active",
+                       "Light-gateway sync sessions currently in flight.",
+                       gw("sessions_active"))
+        reg.gauge_func("lightgw", "sessions_rejected",
+                       "Light-gateway sessions shed at the concurrency cap.",
+                       gw("sessions_rejected"))
+        reg.gauge_func("lightgw", "plan_cache_hits",
+                       "Descent plans answered from the memoized plan cache.",
+                       gw("plan_hits"))
+        reg.gauge_func("lightgw", "proofs_served",
+                       "MMR cold-sync inclusion proofs served.",
+                       gw("proofs_served"))
+        reg.gauge_func("lightgw", "plan_share_ratio_milli",
+                       "Plans served per plan computed x1000.",
+                       gw_share_milli)
+
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> None:
@@ -495,6 +566,7 @@ class Node:
                 block_indexer=self.block_indexer,
                 proxy_app_query=self.proxy_app.query,
                 p2p_peers=self.switch,
+                light_gateway=self.light_gateway,
             )
             self._rpc_env = env
             routes_map = routes(env)

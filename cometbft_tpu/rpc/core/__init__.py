@@ -40,6 +40,10 @@ class Environment:
     block_indexer: object = None
     proxy_app_query: object = None
     p2p_peers: object = None  # switch-like: .peers() / .node_info()
+    # Light-client gateway accessor: a zero-arg callable returning the
+    # node's LightGateway (constructing it on first use) or None when
+    # disabled — lazy so serving unrelated RPC never builds the gateway.
+    light_gateway: object = None
     is_listening: bool = True
 
 
@@ -537,6 +541,67 @@ def routes(env: Environment) -> dict:
             return {"enabled": False}
         return {"enabled": True, **env.ingress.stats()}
 
+    # ---- light-client gateway (light/gateway.py) ---------------------------
+
+    def _light_gateway():
+        accessor = env.light_gateway
+        g = accessor() if callable(accessor) else accessor
+        if g is None:
+            raise RPCError(-32603, "light gateway disabled", None)
+        return g
+
+    def light_sync(trusted_height="0", target_height="0"):
+        """Descent plan (pivot + target light blocks, wire-encoded) for a
+        skipping verification the CLIENT re-runs locally — the gateway is
+        an untrusted accelerator, never an arbiter."""
+        from cometbft_tpu.light.gateway import GatewayError
+
+        g = _light_gateway()
+        try:
+            blocks = g.sync_plan(int(trusted_height), int(target_height))
+        except GatewayError as e:
+            raise RPCError(-32603, f"light_sync: {e}", None)
+        return {
+            "heights": [str(b.height) for b in blocks],
+            "blocks": [_b64(b.encode()) for b in blocks],
+        }
+
+    def light_proof(height="0", anchor_height="0"):
+        """Target light block + MMR inclusion proofs for the target header
+        and the caller's trust anchor under one accumulator root."""
+        from cometbft_tpu.light.gateway import GatewayError
+
+        g = _light_gateway()
+        try:
+            p = g.prove(int(height), anchor_height=int(anchor_height))
+        except GatewayError as e:
+            raise RPCError(-32603, f"light_proof: {e}", None)
+        out = {
+            "size": str(p["size"]),
+            "root": _hexu(p["root"]),
+            "light_block": _b64(p["light_block"].encode()),
+            "target": {
+                "index": str(p["target"]["index"]),
+                "aunts": [_hexu(a) for a in p["target"]["aunts"]],
+            },
+            "proof_bytes": str(p["bytes"]),
+        }
+        if "anchor" in p:
+            out["anchor"] = {
+                "index": str(p["anchor"]["index"]),
+                "aunts": [_hexu(a) for a in p["anchor"]["aunts"]],
+            }
+        return out
+
+    def light_gateway_stats():
+        """Gateway counters (sessions, plan cache, proofs) for operators
+        and the e2e swarm perturbations' delta checks."""
+        accessor = env.light_gateway
+        g = accessor() if callable(accessor) else accessor
+        if g is None:
+            return {"enabled": False}
+        return {"enabled": True, **g.stats()}
+
     def tx(hash="", prove=False):
         if env.tx_indexer is None:
             raise RPCError(-32603, "transaction indexing is disabled", None)
@@ -712,6 +777,9 @@ def routes(env: Environment) -> dict:
         "broadcast_tx_commit": broadcast_tx_commit,
         "check_tx": check_tx,
         "ingress_stats": ingress_stats,
+        "light_sync": light_sync,
+        "light_proof": light_proof,
+        "light_gateway_stats": light_gateway_stats,
         "abci_info": abci_info,
         "abci_query": abci_query,
         "broadcast_evidence": broadcast_evidence,

@@ -57,3 +57,10 @@ def pytest_configure(config):
         "WAL group commit, blocksync verify/apply pipeline); runs in "
         "tier-1 — `-m hotpath` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "lightgw: light-client gateway tests (MMR accumulator vs "
+        "RFC-6962, gateway-vs-local bit-identity, poisoned-proof "
+        "fallback, plan-sharing concurrency); runs in tier-1 — "
+        "`-m lightgw` selects just this group",
+    )

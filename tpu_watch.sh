@@ -62,10 +62,11 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath stages ride along in the carried
-      # JSON (host-side scheduler/admission/vote-batching speedups measured
-      # while the device was serving); surface them in the history. None
-      # gates alt-mode adoption below. Helper python is CPU-only parsing.
+      # The coalesce + ingress + hotpath + lightgw stages ride along in the
+      # carried JSON (host-side scheduler/admission/vote-batching/gateway
+      # speedups measured while the device was serving); surface them in
+      # the history. None gates alt-mode adoption below. Helper python is
+      # CPU-only parsing.
       CO=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu timeout 60 \
            python - <<'PYEOF' 2>/dev/null
 import json
@@ -82,6 +83,10 @@ parts.append(
     f"hotpath {h['speedup']}x {h['batched_dispatches']}dsp "
     f"devnet {h['devnet_before_blocks_per_s']}->"
     f"{h['devnet_after_blocks_per_s']}b/s" if h else "hotpath absent")
+lg = rec.get("stages", {}).get("lightgw")
+parts.append(
+    f"lightgw {lg['speedup']}x proof {lg['lightgw_proof_bytes']}B "
+    f"({lg['proof_bytes_ratio']}x)" if lg else "lightgw absent")
 print("; ".join(parts))
 PYEOF
       )
