@@ -70,6 +70,7 @@ elif _sticky:
     os.environ.setdefault("CMTPU_FE_MODE", _sticky)
 PROBE_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_PROBE_TIMEOUT", "120"))
 TPU_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_TPU_TIMEOUT", "480"))
+MESH_TIMEOUT_S = int(os.environ.get("CMTPU_BENCH_MESH_TIMEOUT", "480"))
 # Leave headroom before TPU_TIMEOUT_S: optional stages are skipped once the
 # worker passes this many seconds.
 STAGE_BUDGET_S = int(os.environ.get("CMTPU_BENCH_STAGE_BUDGET", "330"))
@@ -356,6 +357,207 @@ class _LazyChain:
         return _P()
 
 
+# -- pod-scale mesh stage ------------------------------------------------------
+
+
+def _fit_and_model(widths, n_sigs, ms_per_lane, overhead_ms):
+    """Pure model: the verify wall for ONE merged n_sigs dispatch at each
+    mesh width, from a measured per-lane rate and a fixed per-dispatch
+    overhead (the sharded program is pure data parallel — zero collectives
+    — so lanes split evenly; the mesh-aware ladder pads the remainder).
+    Returns the curve narrowest-first, each row carrying its speedup vs the
+    width-1 row."""
+    curve = []
+    for w in sorted({int(w) for w in widths if int(w) >= 1}):
+        lanes = -(-n_sigs // w)  # ceil: the padded per-chip share
+        curve.append(
+            {
+                "devices": w,
+                "verify_ms": round(overhead_ms + lanes * ms_per_lane, 3),
+            }
+        )
+    base = next(
+        (r["verify_ms"] for r in curve if r["devices"] == 1),
+        curve[0]["verify_ms"] if curve else 0.0,
+    )
+    for row in curve:
+        row["speedup"] = (
+            round(base / row["verify_ms"], 2) if row["verify_ms"] > 0 else 0.0
+        )
+    return curve
+
+
+def _mesh_stage_inner(plog) -> dict:
+    """Pod-scaling stage (runs inside a jax-capable process): calibrate the
+    REAL single-device and mesh-sharded verify walls at two small buckets,
+    assert the sharded program is bit-identical to the single-device bitmap,
+    then model the CMTPU_BENCH_MESH_SIGS merged dispatch across
+    CMTPU_BENCH_MESH_WIDTHS from the measured per-lane rate + dispatch
+    overhead (`modeled: true` in the JSON — on the single-core virtual mesh
+    the chips share one core, so the curve is the rate model's, same
+    convention as the other stages' simulated dispatch costs; on a real pod
+    the calibration walls themselves are the device evidence).  Also runs
+    the subtree-parallel Merkle route against the host root."""
+    t0 = time.time()
+    import numpy as np
+
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    n_sigs = int(os.environ.get("CMTPU_BENCH_MESH_SIGS", "65536"))
+    widths = os.environ.get("CMTPU_BENCH_MESH_WIDTHS", "1,2,4,8").split(",")
+    b2 = int(os.environ.get("CMTPU_BENCH_MESH_CAL_MAX", "4096"))
+    b1 = 128 if b2 > 128 else 8
+    width = ek.mesh_width()
+
+    pvs, pubs, msgs, sigs = _signed_batch(b2, tag=b"mesh")
+    plog(f"mesh: signed {b2} calibration messages (mesh width {width})")
+    operands2, host_ok2 = ek.pack_batch(pubs, msgs, sigs)
+    operands1, _ = ek.pack_batch(pubs[:b1], msgs[:b1], sigs[:b1])
+    f1 = ek._compiled(*ek._bucket_key(operands1))
+    f2 = ek._compiled(*ek._bucket_key(operands2))
+    ok1 = np.asarray(f1(*operands1))  # compile + correctness
+    ok2 = np.asarray(f2(*operands2))
+    assert ok2[:b2].all(), "mesh calibration batch must verify"
+    w1 = best_of(lambda: np.asarray(f1(*operands1)), reps=2)
+    w2 = best_of(lambda: np.asarray(f2(*operands2)), reps=2)
+    plog(f"mesh: single-device walls {b1}: {w1:.1f} ms, {b2}: {w2:.1f} ms")
+    ms_per_lane = max((w2 - w1) / max(b2 - b1, 1), 1e-6)
+    overhead_ms = max(w1 - b1 * ms_per_lane, 0.0)
+
+    cal = {
+        "bucket_small": b1,
+        "bucket_large": b2,
+        "single_ms_small": round(w1, 3),
+        "single_ms_large": round(w2, 3),
+        "ms_per_lane": round(ms_per_lane, 6),
+        "dispatch_overhead_ms": round(overhead_ms, 3),
+    }
+    sh = ek._sharded_verify()
+    if sh is not None and b2 % sh[0] == 0:
+        sharded_ok = np.asarray(sh[1](*operands2))  # compile
+        cal["sharded_ms_large"] = round(
+            best_of(lambda: np.asarray(sh[1](*operands2)), reps=2), 3
+        )
+        cal["sharded_bit_identical"] = bool(np.array_equal(sharded_ok, ok2))
+        assert cal["sharded_bit_identical"], "mesh bitmap != single-device"
+        plog(
+            f"mesh: sharded wall {b2} over {sh[0]} chips "
+            f"{cal['sharded_ms_large']} ms (bit-identical)"
+        )
+
+    curve = _fit_and_model(widths, n_sigs, ms_per_lane, overhead_ms)
+    result = {
+        "n_devices": width,
+        "sigs": n_sigs,
+        "modeled": True,
+        "calibration": cal,
+        "curve": curve,
+        "speedup_widest_vs_1": curve[-1]["speedup"] if curve else 0.0,
+    }
+
+    # ---- subtree-parallel Merkle route (time-gated: 2 more compiles) ----
+    if time.time() - t0 < MESH_TIMEOUT_S * 0.6:
+        try:
+            from cometbft_tpu.crypto.merkle import hash_from_byte_slices
+            from cometbft_tpu.ops import merkle_kernel as mk
+            from cometbft_tpu.ops import sha256_kernel as sha
+
+            n_leaves = int(os.environ.get("CMTPU_BENCH_MESH_LEAVES", "4096"))
+            txs = [b"mesh-tx-%08d" % i for i in range(n_leaves)]
+            blocks, nblocks = sha.pack_messages([b"\x00" + t for t in txs])
+            want = hash_from_byte_slices(txs)
+            shr = mk._sharded_root()
+            if shr is not None and n_leaves % shr[0] == 0:
+                import jax.numpy as jnp
+
+                db, dn = jnp.asarray(blocks), jnp.asarray(nblocks)
+                single_fn = mk._leaves_to_root_jit(blocks.shape[0], n_leaves)
+
+                def _single():
+                    return sha.digest_words_to_bytes(
+                        np.asarray(single_fn(db, dn))
+                    )[0]
+
+                def _mesh_root():
+                    return sha.digest_words_to_bytes(
+                        np.asarray(shr[1](db, dn))
+                    )[0]
+
+                assert _single() == want and _mesh_root() == want
+                result["merkle"] = {
+                    "leaves": n_leaves,
+                    "single_ms": round(best_of(_single, reps=2), 3),
+                    "sharded_ms": round(best_of(_mesh_root, reps=2), 3),
+                    "root_identical": True,
+                }
+                plog(
+                    f"mesh: merkle {n_leaves} leaves single "
+                    f"{result['merkle']['single_ms']} ms, sharded "
+                    f"{result['merkle']['sharded_ms']} ms (roots match)"
+                )
+        except Exception as e:
+            plog(f"mesh merkle sub-stage failed: {type(e).__name__}: {e}")
+
+    result["mesh_counters"] = ek.mesh_counters()
+    return result
+
+
+def mesh_worker() -> None:
+    """--mesh-worker argv mode: the mesh stage in its own jax process (the
+    CPU fallback parent deliberately never imports jax), pinned to the
+    virtual mesh by the parent's env. Emits one MESH_JSON line."""
+    t0 = time.time()
+
+    def plog(msg):
+        print(f"[mesh {time.time() - t0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+    plog(f"start; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:
+        plog(f"cache config failed: {e}")
+    print("MESH_JSON " + json.dumps(_mesh_stage_inner(plog)), flush=True)
+
+
+def _mesh_stage_subprocess():
+    """Launch --mesh-worker on the 8-device virtual CPU mesh; returns the
+    parsed stage dict or None (a wedged/failed worker never gates the
+    fallback's JSON line)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the axon relay
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # Small real-wall calibration buckets: XLA:CPU verifies ~7 ms/lane, so
+    # the defaults sized for a pod would spend minutes on calibration.
+    env.setdefault("CMTPU_BENCH_MESH_CAL_MAX", "512")
+    env.setdefault("CMTPU_BENCH_MESH_LEAVES", "1024")
+    out = run_phase_logged(
+        [sys.executable, "-u", __file__, "--mesh-worker"],
+        MESH_TIMEOUT_S,
+        "mesh",
+        env=env,
+    )
+    for line in (out or "").splitlines():
+        if line.startswith("MESH_JSON "):
+            try:
+                return json.loads(line[len("MESH_JSON "):])
+            except ValueError:
+                return None
+    return None
+
+
 # -- TPU worker ----------------------------------------------------------------
 
 
@@ -400,6 +602,7 @@ def tpu_worker() -> None:
     from cometbft_tpu.ops import sha256_kernel as sha
 
     stages = {}
+    stages["n_devices"] = len(devs)
     # Attribution: which kernel variant produced this line (the RESOLVED
     # lowering — 'auto' would label different variants identically).
     from cometbft_tpu.ops import field25519 as _fe
@@ -593,6 +796,17 @@ def tpu_worker() -> None:
         except Exception as e:
             plog(f"device proofs stage failed: {type(e).__name__}: {e}")
 
+    # ---- pod-scale mesh scaling curve (calibrated + modeled widths) ----
+    if budget_left():
+        try:
+            stages["mesh"] = _mesh_stage_inner(plog)
+            plog(
+                f"mesh: width {stages['mesh']['n_devices']}, "
+                f"{stages['mesh'].get('speedup_widest_vs_1')}x vs 1 device"
+            )
+        except Exception as e:
+            plog(f"mesh stage failed: {type(e).__name__}: {e}")
+
     # ---- shipped-path configs (BASELINE #2/#4/#5) over the shipped
     # backend: hybrid when the native tier built, device-only otherwise ----
     try:
@@ -603,6 +817,7 @@ def tpu_worker() -> None:
         ship = "tpu"
     shipped_path_stages(stages, plog, budget_left, backend=ship)
 
+    stages["mesh_counters"] = ek.mesh_counters()
     plog(f"done on {devs[0].platform}")
     with emit_once:
         finished.set()
@@ -1571,6 +1786,12 @@ def cpu_fallback() -> None:
         )
     except Exception as e:  # never lose the JSON line to a stage failure
         log(f"cpu shipped-path stages failed: {type(e).__name__}: {e}")
+    # Pod-scale mesh curve on the virtual 8-device mesh (subprocess: this
+    # process pinned CMTPU_BACKEND=cpu away from jax on purpose).
+    if time.time() - t0 < STAGE_BUDGET_S:
+        mesh = _mesh_stage_subprocess()
+        if mesh is not None:
+            stages["mesh"] = mesh
     # The axon relay flaps for hours at a time. If the tpu_watch.sh watcher
     # captured a device run earlier (while the relay was up), attach it —
     # clearly labeled as a previous run — so a dead-tunnel round still
@@ -1635,5 +1856,7 @@ def main() -> int:
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         tpu_worker()
+    elif "--mesh-worker" in sys.argv:
+        mesh_worker()
     else:
         sys.exit(main())

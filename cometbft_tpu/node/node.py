@@ -176,6 +176,7 @@ class Node:
             reg.gauge_func("blockstore", "base", "Block store base height.",
                            lambda: self.block_store.base())
             self._register_backend_metrics(reg)
+            self._register_mesh_metrics(reg)
             self._register_hotpath_metrics(reg)
             self._register_lightgw_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
@@ -409,6 +410,40 @@ class Node:
         reg.gauge_func("scheduler", "queue_wait_p95_us",
                        "95th-percentile coalescer queue wait, microseconds.",
                        sched_sample("queue_wait_p95_us"))
+
+    @staticmethod
+    def _register_mesh_metrics(reg) -> None:
+        """mesh_* gauges: pod-scale sharding of the device verify tier
+        (device count, sharded dispatches, bucket-padding lanes, sharded
+        merkle roots).  Strictly passive — the sampler reads the ed25519
+        kernel module only if something else already imported it, and the
+        device count only if something already probed it, so a scrape never
+        imports jax or touches a possibly-wedged device tunnel."""
+        import sys as _sys
+
+        def mesh_sample(key):
+            def fn():
+                ek = _sys.modules.get("cometbft_tpu.ops.ed25519_kernel")
+                if ek is None:
+                    return 0
+                return ek.mesh_counters().get(key, 0)
+
+            return fn
+
+        reg.gauge_func("mesh", "devices",
+                       "Process-local chips one verify dispatch shards "
+                       "across (0 until the device tier probes).",
+                       mesh_sample("devices"))
+        reg.gauge_func("mesh", "sharded_dispatches",
+                       "Verify dispatches routed to the multi-chip program.",
+                       mesh_sample("sharded_dispatches"))
+        reg.gauge_func("mesh", "padded_lanes",
+                       "Bucket-padding lanes shipped on sharded dispatches.",
+                       mesh_sample("padded_lanes"))
+        reg.gauge_func("mesh", "merkle_sharded_dispatches",
+                       "Fused merkle roots served by the subtree-parallel "
+                       "mesh program.",
+                       mesh_sample("merkle_sharded_dispatches"))
 
     def _register_hotpath_metrics(self, reg) -> None:
         """Consensus hot-path gauges: the vote-admission micro-batcher, WAL

@@ -60,11 +60,87 @@ BUCKETS = (8, 32, 128, 512, 1024, 2048, 4096, 6144, 8192, 10240, 16384, 32768)
 BLOCK_BUCKETS = (2, 4, 8, 32)
 
 
+_probed_width = 0  # mesh_width()'s last answer; 0 = never probed
+
+
+@functools.lru_cache(maxsize=1)
+def mesh_width() -> int:
+    """Process-local chips one verify dispatch can shard across (the 1-D
+    `sig` mesh of ops/sharded). 1 under CMTPU_HOST_HASH — the hosthash
+    program is never mesh-sharded — and 1 when the device probe fails.
+    First call may initialize the JAX backend; callers that must never do
+    that (node metric scrapes, the coalescer's default cap) read
+    known_mesh_width() instead."""
+    global _probed_width
+    n = 1
+    if not HOST_HASH:
+        try:
+            n = max(1, jax.local_device_count())
+        except Exception:
+            n = 1
+    _probed_width = n
+    return n
+
+
+def known_mesh_width() -> int:
+    """mesh_width() if some caller already probed it, else 0. Never
+    initializes jax — safe from lazy metric closures and constructors that
+    must not touch a possibly-wedged device tunnel."""
+    return _probed_width
+
+
+def mesh_floor() -> int:
+    """Smallest batch bucket worth spreading across the mesh. Default:
+    the mesh width itself (each chip gets at least one lane — the historic
+    divisibility rule's implicit floor); CMTPU_MESH_FLOOR overrides for
+    deployments where tiny sharded dispatches lose to collective setup."""
+    env = os.environ.get("CMTPU_MESH_FLOOR", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return mesh_width()
+
+
 def bucket_for(n: int) -> int:
+    """Batch bucket for n signatures, rounded up to a multiple of the mesh
+    width once at/above the sharding floor — every bucket the router would
+    shard divides the device count evenly, so a 6-chip host pads 2048 to
+    2052 instead of leaving 5 chips idle (the pre-mesh ladder silently fell
+    back to one chip for any non-divisible bucket)."""
     for b in BUCKETS:
         if n <= b:
-            return b
-    return int(2 ** np.ceil(np.log2(n)))
+            break
+    else:
+        b = int(2 ** np.ceil(np.log2(n)))
+    w = mesh_width()
+    if w > 1 and b >= mesh_floor() and b % w:
+        b += w - b % w
+    return b
+
+
+_mesh_lock = threading.Lock()
+_mesh_counters = {
+    "sharded_dispatches": 0,  # verify dispatches routed to the mesh program
+    "padded_lanes": 0,        # bucket-padding lanes shipped on those
+    "merkle_sharded_dispatches": 0,  # fused roots via the subtree program
+}
+
+
+def mesh_counters() -> dict:
+    """Snapshot of the mesh routing counters plus the (passively read)
+    device count — the source for the node's lazy mesh_* gauges and the
+    bench JSON's attribution fields."""
+    with _mesh_lock:
+        out = dict(_mesh_counters)
+    out["devices"] = known_mesh_width()
+    return out
+
+
+def _mesh_count(key: str, delta: int = 1) -> None:
+    with _mesh_lock:
+        _mesh_counters[key] += delta
 
 
 def block_bucket_for(b: int) -> int:
@@ -341,34 +417,46 @@ def _sharded_verify():
     jax.distributed joins a multi-host cluster, a mesh over the global
     device list would contain non-addressable devices and break every
     ordinary local verify."""
-    n_dev = jax.local_device_count()
-    if n_dev <= 1 or HOST_HASH:
+    n_dev = mesh_width()
+    if n_dev <= 1:
         return None
     from cometbft_tpu.ops import sharded
 
     return n_dev, sharded.sharded_verify_fn(sharded.make_mesh(jax.local_devices()))
 
 
-def _verify_fn_for(operands):
-    """The compiled program the routing layer would run for these packed
+def _route_for(operands):
+    """(program, mesh-sharded?) the routing layer would run for these packed
     operands: the lane-sharded multi-chip program when this process owns
-    several chips and the bucket divides evenly, else the single-device
-    bucket program. Shared by batch_verify_submit and warmup so warmup
-    precompiles what will actually run."""
+    several chips and the bucket is at/above the sharding floor (the
+    mesh-aware ladder guarantees such buckets divide the device count),
+    else the single-device bucket program."""
     key = _bucket_key(operands)
     if key[1] != 0:  # hosthash program shapes aren't mesh-sharded
         sh = _sharded_verify()
-        if sh is not None and operands[0].shape[1] % sh[0] == 0:
-            return sh[1]
-    return _compiled(*key)
+        if (
+            sh is not None
+            and key[0] >= mesh_floor()
+            and key[0] % sh[0] == 0
+        ):
+            return sh[1], True
+    return _compiled(*key), False
+
+
+def _verify_fn_for(operands):
+    """Shared by batch_verify_submit and warmup so warmup precompiles what
+    will actually run."""
+    return _route_for(operands)[0]
 
 
 def clear_compiled_caches() -> None:
     """Retrace seam for the fe-lowering tests: drops BOTH program caches
-    (the per-bucket single-device jits and the sharded-mesh jit) so a
-    flipped CMTPU_FE_MODE actually re-lowers what batch_verify runs."""
+    (the per-bucket single-device jits and the sharded-mesh jit) plus the
+    cached mesh width so a flipped CMTPU_FE_MODE actually re-lowers what
+    batch_verify runs."""
     _compiled.cache_clear()
     _sharded_verify.cache_clear()
+    mesh_width.cache_clear()
 
 
 def batch_verify_submit(pubs, msgs, sigs):
@@ -379,7 +467,10 @@ def batch_verify_submit(pubs, msgs, sigs):
     n = len(pubs)
     operands, host_ok = pack_batch(pubs, msgs, sigs)
     key = _bucket_key(operands)
-    fn = _verify_fn_for(operands)
+    fn, sharded = _route_for(operands)
+    if sharded:
+        _mesh_count("sharded_dispatches")
+        _mesh_count("padded_lanes", key[0] - n)
     fut = _pool().submit(lambda: np.asarray(fn(*operands)))
 
     def collect() -> tuple[bool, list]:

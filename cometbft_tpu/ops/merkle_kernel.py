@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import os
 
 import numpy as np
 
@@ -140,9 +141,40 @@ def _leaves_to_root_jit(bmax: int, n: int):
     return jax.jit(leaves_to_root_core)
 
 
+@functools.lru_cache(maxsize=1)
+def _sharded_root():
+    """(mesh width, sharded fused leaves->root fn) when this process owns
+    multiple chips and the width is a power of two (the subtree-roots top
+    reduction pairs level-synchronously), else None. Lazy import: merkle
+    callers on single-chip hosts never pull the ed25519 kernel graph."""
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    w = ek.mesh_width()
+    if w <= 1 or w & (w - 1):
+        return None
+    from cometbft_tpu.ops import sharded
+
+    return w, sharded.sharded_leaves_to_root_fn(
+        sharded.make_mesh(jax.local_devices())
+    )
+
+
+def _mesh_merkle_floor() -> int:
+    """Leaf count from which the fused root routes to the subtree-parallel
+    mesh program. On a single chip the fused program already wins; sharding
+    only pays once the leaf pass dominates the collective + top reduction."""
+    try:
+        return max(1, int(os.environ.get("CMTPU_MESH_MERKLE_FLOOR", "16384")))
+    except ValueError:
+        return 16384
+
+
 def merkle_root_fused(leaves: list[bytes]) -> bytes:
     """RFC-6962 root in one device dispatch (power-of-two leaf counts; the
-    general path pads via duplicate-free promotion in merkle_root)."""
+    general path pads via duplicate-free promotion in merkle_root). Forests
+    at/above CMTPU_MESH_MERKLE_FLOOR route to ops/sharded's subtree-parallel
+    program when this process owns a power-of-two mesh — each chip leaf-
+    hashes and reduces its own subtree, still one dispatch end to end."""
     n = len(leaves)
     if n == 0:
         return hashlib.sha256(b"").digest()
@@ -150,6 +182,16 @@ def merkle_root_fused(leaves: list[bytes]) -> bytes:
         return merkle_root(leaves)
     msgs = [b"\x00" + it for it in leaves]
     blocks, nblocks = sha.pack_messages(msgs)
+    if n >= _mesh_merkle_floor():
+        sh = _sharded_root()
+        # n and width are both pow2 here, so divisibility of the shard
+        # size follows whenever the mesh isn't wider than the forest.
+        if sh is not None and n % sh[0] == 0:
+            from cometbft_tpu.ops import ed25519_kernel as ek
+
+            ek._mesh_count("merkle_sharded_dispatches")
+            out = sh[1](jnp.asarray(blocks), jnp.asarray(nblocks))
+            return sha.digest_words_to_bytes(np.asarray(out))[0]
     out = _leaves_to_root_jit(blocks.shape[0], n)(blocks, nblocks)
     return sha.digest_words_to_bytes(np.asarray(out))[0]
 

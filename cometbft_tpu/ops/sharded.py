@@ -16,6 +16,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax.shard_map graduated from experimental in newer releases
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
 from cometbft_tpu.ops import ed25519_kernel as ek
 from cometbft_tpu.ops import merkle_kernel as mk
 from cometbft_tpu.ops import sha256_kernel as sha
@@ -74,7 +79,7 @@ def sharded_merkle_fn(mesh: Mesh, axis: str = "sig"):
         return _local_tree_root(roots)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=P(None, axis),
@@ -82,6 +87,33 @@ def sharded_merkle_fn(mesh: Mesh, axis: str = "sig"):
         )
     )
     return lambda leaves: fn(leaves)[:, :1]
+
+
+def sharded_leaves_to_root_fn(mesh: Mesh, axis: str = "sig"):
+    """shard_map'd FUSED leaves->root: pre-padded leaf messages (blocks
+    uint32[B, 16, n], nblocks int32[n]; n = pow2, n/mesh-size a pow2) are
+    leaf-hashed shard-local, each chip reduces its subtree, subtree roots
+    ride one all_gather, and every chip finishes the (tiny) replicated top.
+    The multi-chip analog of merkle_kernel.leaves_to_root_core — one
+    dispatch end to end, which is what matters on tunneled deployments.
+    Returns uint32[8, 1]."""
+
+    def local(block_shard, nblock_shard):
+        root = _local_tree_root(mk._leaf_core(block_shard, nblock_shard))
+        roots = jax.lax.all_gather(root[:, 0], axis, axis=1)  # [8, ndev]
+        # Identical top reduction on every device; emit one column each
+        # (JAX's varying-axis checker can't see the replication).
+        return _local_tree_root(roots)
+
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, None, axis), P(axis)),
+            out_specs=P(None, axis),
+        )
+    )
+    return lambda blocks, nblocks: fn(blocks, nblocks)[:, :1]
 
 
 def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
@@ -100,7 +132,7 @@ def sharded_commit_step_fn(mesh: Mesh, axis: str = "sig"):
             top = _local_tree_root(roots)  # identical on every device
             return total_ok[None], top
 
-        total_ok, root_cols = jax.shard_map(
+        total_ok, root_cols = shard_map(
             reduce_shard,
             mesh=mesh,
             in_specs=(P(axis), P(None, axis)),

@@ -146,7 +146,15 @@ class HybridBackend(VerifyBackend):
         native.ensure_built_async()
         self._tpu = TpuBackend()
         self._cpu = CpuBackend()
-        # sigs/ms; seeded from the first real TPU v5e stage splits
+        from cometbft_tpu.ops import ed25519_kernel as _ek
+
+        # Chips one dispatch shards across: the planner prices the mesh as
+        # ONE large device (per-chip rate x width, shared dispatch
+        # overhead). Probing here is safe — device_backend("auto") already
+        # ran jax.devices() before constructing this tier, and an explicit
+        # CMTPU_BACKEND=hybrid means the operator asked for the device.
+        self._n_dev = _ek.mesh_width()
+        # sigs/ms PER CHIP; seeded from the first real TPU v5e stage splits
         # (tpu_bench_latest.json: verify 102 ms / 10,240 sigs device-side,
         # 147 ms native) and corrected by an EMA after every split call.
         self._dev_rate = float(os.environ.get("CMTPU_DEV_RATE", "100"))
@@ -155,16 +163,18 @@ class HybridBackend(VerifyBackend):
         self._dev_overhead = float(os.environ.get("CMTPU_DEV_OVERHEAD_MS", "8"))
         self._min_split = int(os.environ.get("CMTPU_HYBRID_MIN", "2048"))
         self._rate_lock = threading.Lock()
-        # Compiled-program keys (batch bucket, block bucket) that have
-        # already run once in this process: the first dispatch of a program
-        # can pay a multi-second XLA compile, which must not be charged to
-        # the steady-state rate model.
+        # Compiled-program keys (batch bucket, block bucket, mesh width)
+        # that have already run once in this process: the first dispatch of
+        # a program can pay a multi-second XLA compile, which must not be
+        # charged to the steady-state rate model.
         self._warmed: set[tuple] = set()
-        # Measured device wall per batch bucket (EMA, straggler-observed
-        # only). The device cost is AFFINE — tens of ms of fixed tunnel +
-        # dispatch latency plus a per-lane slope — so a single sigs/ms rate
-        # learned at one bucket misprices every other; real walls win.
-        self._dev_wall: dict[int, float] = {}
+        # Measured device wall per (batch bucket, mesh width) — EMA,
+        # straggler-observed only. The device cost is AFFINE — tens of ms
+        # of fixed tunnel + dispatch latency plus a per-lane slope — so a
+        # single sigs/ms rate learned at one bucket misprices every other;
+        # real walls win. Width in the key so a mesh-size change (or a test
+        # flipping the virtual mesh) can't reuse stale single-chip walls.
+        self._dev_wall: dict[tuple[int, int], float] = {}
         # Hill-climb bias on the bucket ladder: when the device finishes
         # early its true wall is unobservable (collect() never blocks), so
         # the rate model alone can NEVER learn to grow the device share —
@@ -185,8 +195,17 @@ class HybridBackend(VerifyBackend):
         # bucket keys from straggler-collect threads, and iterating the live
         # dict here would race that insert (RuntimeError: dictionary changed
         # size during iteration) escaping into consensus/blocksync callers.
+        # Only walls observed at the CURRENT mesh width apply.
+        n_dev = self._n_dev
         with self._rate_lock:
-            walls = dict(self._dev_wall)
+            walls = {
+                b: w for (b, nd), w in self._dev_wall.items() if nd == n_dev
+            }
+        # Mesh pricing: lanes run data-parallel across the chips, so the
+        # modeled throughput is per-chip rate x width over ONE shared
+        # dispatch overhead — without this an 8-chip mesh gets starved
+        # with single-chip-sized shares.
+        mesh_rate = self._dev_rate * n_dev
 
         def dev_ms(b):  # padded lanes compute like real ones
             bucket = ek.bucket_for(b)
@@ -202,12 +221,12 @@ class HybridBackend(VerifyBackend):
             if len(obs) == 1:
                 b1, w1 = obs[0]
                 if bucket > b1:
-                    return w1 + (bucket - b1) / self._dev_rate
+                    return w1 + (bucket - b1) / mesh_rate
                 # smaller buckets still pay the fixed dispatch floor
                 return max(
-                    w1 - (b1 - bucket) / self._dev_rate, self._dev_overhead
+                    w1 - (b1 - bucket) / mesh_rate, self._dev_overhead
                 )
-            return bucket / self._dev_rate + self._dev_overhead
+            return bucket / mesh_rate + self._dev_overhead
 
         def host_ms(k):
             return k / self._host_rate
@@ -301,10 +320,12 @@ class HybridBackend(VerifyBackend):
         alpha = 0.3
         host_ms = (t_host - t_disp) * 1000
         dev_ms = (t_dev - t0) * 1000
-        first_use = key not in self._warmed
-        self._warmed.add(key)
+        warm_key = (*key, self._n_dev)
+        first_use = warm_key not in self._warmed
+        self._warmed.add(warm_key)
         self.last_timing = {
             "n_dev": n_dev,
+            "mesh_devices": self._n_dev,
             "n_host": n_host,
             "pack_dispatch_ms": round((t_disp - t0) * 1000, 2),
             "host_msm_ms": round(host_ms, 2),
@@ -321,11 +342,14 @@ class HybridBackend(VerifyBackend):
                 self._host_rate += alpha * (r - self._host_rate)
             straggler = t_dev - t_wait > 0.001
             if straggler and not first_use and dev_ms > self._dev_overhead:
-                r = min(max(n_dev / (dev_ms - self._dev_overhead), 5.0), 5000.0)
+                # Learned rate stays PER CHIP (observed mesh throughput /
+                # width) so it transfers if the mesh width changes.
+                r = n_dev / (dev_ms - self._dev_overhead) / self._n_dev
+                r = min(max(r, 5.0), 5000.0)
                 self._dev_rate += alpha * (r - self._dev_rate)
-                bucket = key[0]
-                prev = self._dev_wall.get(bucket, dev_ms)
-                self._dev_wall[bucket] = prev + alpha * (dev_ms - prev)
+                wall_key = (key[0], self._n_dev)
+                prev = self._dev_wall.get(wall_key, dev_ms)
+                self._dev_wall[wall_key] = prev + alpha * (dev_ms - prev)
             wait_ms = (t_dev - t_wait) * 1000
             if n_host == 0:
                 # All-device/all-host calls carry no idle-tier signal;

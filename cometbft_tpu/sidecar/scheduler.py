@@ -40,6 +40,7 @@ callers, and the supervisor between them bounds whatever is dispatched.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 
@@ -53,6 +54,32 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _mesh_width_for_cap() -> int:
+    """Device count behind the default dispatch cap (16384 x width), read
+    WITHOUT risking a device-tunnel probe from this constructor: use the
+    kernel's already-probed width when available (the auto chain constructs
+    its device tier — which probes — before this layer), and only probe
+    ourselves when JAX is pinned to the local CPU backend with a forced
+    virtual device count (the test/dryrun mesh). Everywhere else the probe
+    could hang a node start behind a wedged axon tunnel, and a cpu-only
+    deployment shouldn't pay a jax import for a cap it can't use."""
+    ek = sys.modules.get("cometbft_tpu.ops.ed25519_kernel")
+    if ek is not None and ek.known_mesh_width():
+        return ek.known_mesh_width()
+    if (
+        os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        and "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+    ):
+        try:
+            from cometbft_tpu.ops import ed25519_kernel as ek2
+
+            return ek2.mesh_width()
+        except Exception:
+            return 1
+    return 1
 
 
 class VerifyFuture:
@@ -114,11 +141,15 @@ class CoalescingScheduler(VerifyBackend):
             if window_ms is None
             else window_ms
         )
-        self.max_sigs = (
-            int(_env_float("CMTPU_COALESCE_MAX", 16384))
-            if max_sigs is None
-            else max_sigs
-        )
+        if max_sigs is not None:
+            self.max_sigs = max_sigs
+        elif os.environ.get("CMTPU_COALESCE_MAX", ""):
+            self.max_sigs = int(_env_float("CMTPU_COALESCE_MAX", 16384))
+        else:
+            # Pod-width default: one merged dispatch can fill every chip
+            # (16384 lanes each — the single-chip cap this generalizes).
+            # An explicit env or arg always wins.
+            self.max_sigs = 16384 * max(1, _mesh_width_for_cap())
         self._queue: list[_Request] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -314,6 +345,7 @@ class CoalescingScheduler(VerifyBackend):
     def counters(self) -> dict:
         with self._cond:
             out = dict(self.counters_)
+        out["max_sigs"] = self.max_sigs
         d = max(1, out["dispatches"])
         out["coalesce_ratio"] = round(out["requests"] / d, 3)
         out["queue_wait_p50_ms"] = round(self._wait_percentile(0.50), 3)

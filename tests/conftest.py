@@ -26,6 +26,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Share bench.py's persistent XLA compile cache: the sharded (shard_map)
+# programs the pod-scale mesh tests exercise cost tens of seconds each to
+# compile on XLA:CPU, and without this every tier-1 sweep re-pays them.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass  # older jax without the persistent cache knobs
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -63,4 +77,11 @@ def pytest_configure(config):
         "RFC-6962, gateway-vs-local bit-identity, poisoned-proof "
         "fallback, plan-sharing concurrency); runs in tier-1 — "
         "`-m lightgw` selects just this group",
+    )
+    config.addinivalue_line(
+        "markers",
+        "mesh: pod-scale sharding tests (mesh-aware bucket ladder, "
+        "sharded-vs-single bitmap bit-identity, planner mesh pricing, "
+        "pod-width coalescer cap, dryrun_multichip) on the 8-device "
+        "virtual mesh; runs in tier-1 — `-m mesh` selects just this group",
     )

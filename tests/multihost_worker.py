@@ -22,6 +22,22 @@ multihost.distributed_init(f"127.0.0.1:{port}", nproc, pid)
 
 import jax  # noqa: E402
 
+# Share the repo's persistent XLA compile cache (same as conftest/bench):
+# the 8-device two-process commit step costs tens of seconds to compile on
+# XLA:CPU and would otherwise be re-paid by every tier-1 sweep.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
+
 from cometbft_tpu.ops import sharded  # noqa: E402
 
 from cometbft_tpu.ops import ed25519_kernel as ek  # noqa: E402

@@ -30,7 +30,12 @@ def _hybrid(monkeypatch, min_split=8, dev_rate=1000.0, host_rate=1000.0):
     monkeypatch.setenv("CMTPU_DEV_RATE", str(dev_rate))
     monkeypatch.setenv("CMTPU_HOST_RATE", str(host_rate))
     monkeypatch.setenv("CMTPU_DEV_OVERHEAD_MS", "0")
-    return be.HybridBackend()
+    hb = be.HybridBackend()
+    # Pin the planner's mesh pricing to one chip so the synthetic-rate
+    # arithmetic these tests assert stays readable (the conftest mesh has 8
+    # virtual devices); mesh pricing has its own tests below.
+    hb._n_dev = 1
+    return hb
 
 
 needs_native = pytest.mark.skipif(
@@ -153,7 +158,7 @@ def test_all_device_path_feeds_model_and_decays_bias(monkeypatch):
     assert hb._bias == 1
     from cometbft_tpu.ops import ed25519_kernel as ek
 
-    assert ek.bucket_for(48) in hb._dev_wall
+    assert (ek.bucket_for(48), hb._n_dev) in hb._dev_wall
 
 
 @needs_native
@@ -211,9 +216,9 @@ def test_plan_snapshots_dev_wall_under_rate_lock(monkeypatch):
         while not stop.is_set():
             k += 1
             with hb._rate_lock:
-                hb._dev_wall[128 * (k % 64 + 1)] = 1.0 + (k % 7)
+                hb._dev_wall[(128 * (k % 64 + 1), 1)] = 1.0 + (k % 7)
                 if k % 5 == 0:
-                    hb._dev_wall.pop(128 * ((k * 31) % 64 + 1), None)
+                    hb._dev_wall.pop((128 * ((k * 31) % 64 + 1), 1), None)
 
     t = threading.Thread(target=writer, daemon=True)
     t.start()
@@ -229,3 +234,45 @@ def test_plan_snapshots_dev_wall_under_rate_lock(monkeypatch):
         stop.set()
         t.join(timeout=2)
     assert not failures, f"_plan raced the rate model: {failures[0]}"
+
+
+@pytest.mark.mesh
+def test_plan_prices_mesh_as_one_large_device(monkeypatch):
+    """With symmetric per-chip rates the single-chip planner splits a batch
+    evenly; an 8-chip mesh must be priced as one 8x-faster device (per-chip
+    rate x width over one shared dispatch overhead) and take ~8/9 of it."""
+    hb = _hybrid(monkeypatch, dev_rate=100.0, host_rate=100.0)
+    hb._n_dev = 1
+    assert hb._plan(9216) == 4096
+    hb._n_dev = 8
+    assert hb._plan(9216) == 8192
+
+
+@pytest.mark.mesh
+def test_dev_walls_keyed_by_mesh_width(monkeypatch):
+    """A wall observed at one mesh width must be invisible at another —
+    a stale single-chip wall would make the planner starve the mesh."""
+    hb = _hybrid(monkeypatch, dev_rate=100.0, host_rate=100.0)
+    with hb._rate_lock:
+        hb._dev_wall[(8192, 1)] = 1e9  # poisoned single-chip observation
+    hb._n_dev = 8
+    assert hb._plan(9216) == 8192  # the width-1 wall does not apply
+    hb._n_dev = 1
+    assert hb._plan(9216) == 0  # ...but at width 1 it routes all-host
+
+
+@pytest.mark.mesh
+def test_warm_keys_include_mesh_width(monkeypatch):
+    """First dispatch at a NEW mesh width must count as first_use (a fresh
+    sharded program compiles) even when the same (batch, block) program was
+    already warm at another width."""
+    hb = _hybrid(monkeypatch)
+    ts = (0.0, 0.001, 0.002, 0.002, 0.050)
+    hb._n_dev = 1
+    hb._update_rates((128, 2), 128, 0, *ts)
+    assert hb.last_timing["first_use"]
+    hb._update_rates((128, 2), 128, 0, *ts)
+    assert not hb.last_timing["first_use"]
+    hb._n_dev = 8
+    hb._update_rates((128, 2), 128, 0, *ts)
+    assert hb.last_timing["first_use"], "width change must re-warm"

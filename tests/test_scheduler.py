@@ -386,6 +386,109 @@ def test_flip_fault_cannot_cross_request_boundaries():
         sched.close()
 
 
+# -- pod-scale coalescing ------------------------------------------------
+
+
+def _synthetic(n, tag, poison=()):
+    """Unique byte triples without real crypto — signing a pod-scale pack
+    host-side would take minutes; the marker backend below judges lanes by
+    the signature's first byte instead."""
+    pubs = [(b"%s-p-%d" % (tag, i)).ljust(32, b"\x00") for i in range(n)]
+    msgs = [b"%s-m-%d" % (tag, i) for i in range(n)]
+    sigs = [
+        (b"\x00" if i in poison else b"\x01")
+        + (b"%s-s-%d" % (tag, i)).ljust(63, b"\x02")
+        for i in range(n)
+    ]
+    return pubs, msgs, sigs
+
+
+class _MarkerGate(VerifyBackend):
+    """_GateBackend at pod scale: first call wedges the dispatcher so
+    followers provably queue; verdicts come from the sig marker byte."""
+
+    name = "marker-gate"
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = []
+        self._first = True
+
+    def batch_verify(self, pubs, msgs, sigs):
+        self.calls.append(len(pubs))
+        if self._first:
+            self._first = False
+            self.release.wait(10.0)
+        bits = [s[0] == 1 for s in sigs]
+        return all(bits), bits
+
+    def merkle_root(self, leaves):
+        raise NotImplementedError("verify-only marker backend")
+
+
+@pytest.mark.mesh
+def test_default_cap_scales_with_mesh_width(monkeypatch):
+    """The default dispatch cap is 16384 x mesh width (one merged dispatch
+    can fill every chip); an explicit env or ctor arg always wins."""
+    monkeypatch.delenv("CMTPU_COALESCE_MAX", raising=False)
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0)
+    try:
+        assert sched.max_sigs == 16384 * 8  # the 8-device conftest mesh
+        assert sched.counters()["max_sigs"] == 131072
+    finally:
+        sched.close()
+    monkeypatch.setenv("CMTPU_COALESCE_MAX", "4096")
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0)
+    try:
+        assert sched.max_sigs == 4096
+    finally:
+        sched.close()
+    sched = CoalescingScheduler(CpuBackend(), window_ms=0, max_sigs=5)
+    try:
+        assert sched.max_sigs == 5
+    finally:
+        sched.close()
+
+
+@pytest.mark.mesh
+def test_pod_scale_merged_dispatch_with_per_caller_slicing():
+    """8 x 4096-sig requests — above the old single-chip 16384 cap — must
+    merge into ONE columnar dispatch under the pod-width default cap, and
+    the single poisoned lane must come back to its own caller only."""
+    gate = _MarkerGate()
+    sched = CoalescingScheduler(gate, window_ms=0)
+    try:
+        assert sched.max_sigs >= 8 * 4096
+        head = sched.submit(*_synthetic(1, b"head"))
+        while not gate.calls:  # dispatcher wedged inside call #1
+            time.sleep(0.001)
+        futs = [
+            sched.submit(
+                *_synthetic(4096, b"req-%d" % i,
+                            poison={100} if i == 3 else ())
+            )
+            for i in range(8)
+        ]
+        gate.release.set()
+        assert head.result(10.0) == (True, [True])
+        for i, fut in enumerate(futs):
+            ok, bits = fut.result(30.0)
+            assert len(bits) == 4096
+            if i == 3:
+                assert not ok
+                assert [j for j, b in enumerate(bits) if not b] == [100]
+            else:
+                assert ok and all(bits)
+        assert gate.calls == [1, 32768], "pod batch must be ONE dispatch"
+        c = sched.counters()
+        assert c["coalesced_dispatches"] == 1
+        assert c["batched_requests"] == 8
+        assert c["fallback_splits"] == 0
+    finally:
+        gate.release.set()
+        sched.close()
+
+
 def test_auto_backend_composition_strips_with_knob(monkeypatch):
     monkeypatch.setenv("CMTPU_BACKEND", "auto")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")

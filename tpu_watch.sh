@@ -62,7 +62,7 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath + lightgw stages ride along in the
+      # The coalesce + ingress + hotpath + lightgw + mesh stages ride in the
       # carried JSON (host-side scheduler/admission/vote-batching/gateway
       # speedups measured while the device was serving); surface them in
       # the history. None gates alt-mode adoption below. Helper python is
@@ -87,6 +87,12 @@ lg = rec.get("stages", {}).get("lightgw")
 parts.append(
     f"lightgw {lg['speedup']}x proof {lg['lightgw_proof_bytes']}B "
     f"({lg['proof_bytes_ratio']}x)" if lg else "lightgw absent")
+m = rec.get("stages", {}).get("mesh")
+parts.append(
+    f"mesh {m['n_devices']}dev {m['speedup_widest_vs_1']}x"
+    + (" bit-identical"
+       if m.get("calibration", {}).get("sharded_bit_identical") else "")
+    if m else "mesh absent")
 print("; ".join(parts))
 PYEOF
       )
