@@ -146,10 +146,24 @@ def cmd_devnet(args) -> int:
     from cometbft_tpu.privval import FilePV
     from cometbft_tpu.types import cmttime
     from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
-    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.privval.file import _BY_KEY_TYPE, KEY_TYPES
 
     n = args.validators
-    pvs = [FilePV(ed25519.gen_priv_key()) for _ in range(n)]
+    key_types = [
+        k.strip() for k in getattr(args, "key_types", "ed25519").split(",")
+        if k.strip()
+    ]
+    for k in key_types:
+        if k not in KEY_TYPES:
+            print(
+                f"unknown key type {k!r} (want one of {KEY_TYPES})",
+                file=sys.stderr,
+            )
+            return 1
+    pvs = [
+        FilePV(_BY_KEY_TYPE[key_types[i % len(key_types)]].gen_priv_key())
+        for i in range(n)
+    ]
     doc = GenesisDoc(
         chain_id="devnet",
         genesis_time=cmttime.now(),
@@ -624,6 +638,14 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc-port", type=int, default=26657)
     sp.add_argument("--block-interval", type=float, default=1.0)
     sp.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "hybrid", "auto"])
+    sp.add_argument(
+        "--key-types",
+        default="ed25519",
+        dest="key_types",
+        help="comma list of consensus key types cycled across validators "
+        "(e.g. ed25519,bn254); with CMTPU_AGG_COMMITS=1 an all-bn254 net "
+        "ships aggregate commits",
+    )
     sp.add_argument(
         "--faults",
         default=None,

@@ -923,6 +923,14 @@ class ConsensusState:
             commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
         elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
             commit = rs.last_commit.make_commit()
+            if os.environ.get("CMTPU_AGG_COMMITS", "") == "1":
+                # Block-embedded form only: the seen commit saved in
+                # _finalize keeps per-vote signatures so restart
+                # reconstruction can rebuild the VoteSet (see
+                # types.block.aggregate_commit).
+                from cometbft_tpu.types.block import aggregate_commit
+
+                commit = aggregate_commit(commit, self.state.last_validators)
         else:
             return None
         proposer_addr = self.priv_validator_pub_key.address()

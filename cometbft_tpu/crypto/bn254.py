@@ -560,3 +560,541 @@ def gen_priv_key() -> PrivKey:
         raw = os.urandom(32)
         if int.from_bytes(raw, "big") % R != 0:
             return PrivKey(raw)
+
+
+# ===========================================================================
+# Fast host-tier pairing path (ISSUE 9).
+#
+# Everything above is the reference-faithful slow form and stays untouched —
+# `verify_signature_slow` below preserves it verbatim as the bench scalar
+# arm and the ground-truth the fast path is tested against. The fast path
+# changes the arithmetic, never the decision:
+#
+#  * `final_exponentiation_fast` — easy part by conjugation/Frobenius + one
+#    Fp12 inversion, hard part by the Scott et al. addition chain in the BN
+#    parameter t (3 exponentiations by t instead of one 2790-bit ladder).
+#    Computes exactly f^((p^12-1)/r), asserted value-identical in tests.
+#  * `multi_miller_loop` — one shared Fp12 squaring per iteration across
+#    every pair of a whole commit (the squaring of a product is the product
+#    of squarings, so n Miller loops share their doubling schedule).
+#  * One shared final exponentiation per CHECK, not per signature — the
+#    aggregate-BLS shape from arXiv:2302.00418.
+#
+# Line-function scalings live in Fp2, a proper subfield, so they are killed
+# by the final exponentiation: check results are bit-identical to the slow
+# engine (tested over valid, corrupted, and wrong-key signatures).
+
+
+def f12_sqr(a):
+    return f12_mul(a, a)
+
+
+def f12_inv(a):
+    return f12_conj_like_inv(a)
+
+
+def _f12_conj6(a):
+    """a^(p^6): the nontrivial automorphism fixing Fp6 = Fp2[w^2] — negates
+    the odd-power-of-w coefficients. Equals a^-1 inside the cyclotomic
+    subgroup (post-easy-part), which is what the hard part exploits."""
+    return tuple(c if i % 2 == 0 else f2_neg(c) for i, c in enumerate(a))
+
+
+# gamma[k][i] = xi^(i * (p^k - 1) / 6): the twist constants of the Fp12
+# Frobenius x -> x^(p^k) on the w^i basis.
+_F12_GAMMA = {
+    k: tuple(_f2_pow(XI, i * (P**k - 1) // 6) for i in range(6)) for k in (1, 2, 3)
+}
+
+
+def _f12_frobenius(a, k):
+    """a^(p^k) for k in {1,2,3}: coefficient-wise Fp2 Frobenius (conjugation
+    when k is odd) times the basis twist gamma[k][i]."""
+    g = _F12_GAMMA[k]
+    if k % 2:
+        return tuple(f2_mul(_f2_conj(c), g[i]) for i, c in enumerate(a))
+    return tuple(f2_mul(c, g[i]) for i, c in enumerate(a))
+
+
+def final_exponentiation_fast(f):
+    """f^((p^12-1)/r), value-identical to `final_exponentiation`.
+
+    Easy part (p^6-1)(p^2+1) via conjugation + one Fp12 inversion; hard
+    part (p^4-p^2+1)/r via the Scott-Benger-Charlemagne-Perez-Kachisa
+    addition chain in t (exact exponent, not a multiple)."""
+    # easy part: m = f^((p^6-1)(p^2+1))
+    t = f12_mul(_f12_conj6(f), f12_inv(f))  # f^(p^6-1)
+    m = f12_mul(_f12_frobenius(t, 2), t)  # ^(p^2+1)
+    # hard part: m^((p^4-p^2+1)/r); conj6 = inverse in the cyclotomic group
+    fu = f12_pow(m, _T)
+    fu2 = f12_pow(fu, _T)
+    fu3 = f12_pow(fu2, _T)
+    y0 = f12_mul(
+        f12_mul(_f12_frobenius(m, 1), _f12_frobenius(m, 2)), _f12_frobenius(m, 3)
+    )
+    y1 = _f12_conj6(m)
+    y2 = _f12_frobenius(fu2, 2)
+    y3 = _f12_conj6(_f12_frobenius(fu, 1))
+    y4 = _f12_conj6(f12_mul(fu, _f12_frobenius(fu2, 1)))
+    y5 = _f12_conj6(fu2)
+    y6 = _f12_conj6(f12_mul(fu3, _f12_frobenius(fu3, 1)))
+    t0 = f12_mul(f12_mul(f12_sqr(y6), y4), y5)
+    t1 = f12_mul(f12_mul(y3, y5), t0)
+    t0 = f12_mul(t0, y2)
+    t1 = f12_mul(f12_sqr(t1), t0)
+    t1 = f12_sqr(t1)
+    t0 = f12_mul(t1, y1)
+    t1 = f12_mul(t1, y0)
+    t0 = f12_mul(f12_sqr(t0), t1)
+    return t0
+
+
+def multi_miller_loop(pairs):
+    """prod_i f_{6t+2,Q_i}(P_i) with ONE shared Fp12 squaring per iteration.
+
+    Bit-for-bit the same doubling/addition schedule as `miller_loop` run per
+    pair, but the accumulator is the product, so the per-iteration squaring
+    (the only O(n)-independent cost) is paid once for the whole batch."""
+    live = [(p_pt, q) for p_pt, q in pairs if p_pt is not None and q is not None]
+    if not live:
+        return F12_ONE
+    f = F12_ONE
+    ts = [q for _, q in live]
+    bits = bin(_ATE_LOOP)[3:]
+    for bit in bits:
+        f = f12_sqr(f)
+        for i, (p_pt, q) in enumerate(live):
+            f = f12_mul(f, _line(ts[i], ts[i], p_pt))
+            ts[i] = _g2_add(ts[i], ts[i])
+            if bit == "1":
+                f = f12_mul(f, _line(ts[i], q, p_pt))
+                ts[i] = _g2_add(ts[i], q)
+    for i, (p_pt, q) in enumerate(live):
+        q1 = _g2_frobenius(q)
+        q2 = _g2_neg(_g2_frobenius(q1))
+        f = f12_mul(f, _line(ts[i], q1, p_pt))
+        ts[i] = _g2_add(ts[i], q1)
+        f = f12_mul(f, _line(ts[i], q2, p_pt))
+    return f
+
+
+def pairing_check_fast(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1 via the shared-squaring Miller loop and fast
+    final exponentiation. Decision-identical to `pairing_check`."""
+    return final_exponentiation_fast(multi_miller_loop(pairs)) == F12_ONE
+
+
+# -- hash-to-G2 cache --------------------------------------------------------
+# Vote sign bytes recur across engines (vote admission, commit verify, light
+# client, crosscheck); try-and-increment + cofactor clearing is ~5 ms, so a
+# small LRU removes the dominant per-message cost of re-verification.
+
+_HM_CACHE: dict[bytes, tuple] = {}
+_HM_CACHE_MAX = 8192
+
+
+def _hash_to_g2_cached(msg: bytes):
+    key = bytes(msg)
+    hit = _HM_CACHE.get(key)
+    if hit is not None:
+        return hit
+    q = _hash_to_g2(key)
+    if len(_HM_CACHE) >= _HM_CACHE_MAX:
+        for k in list(_HM_CACHE)[: _HM_CACHE_MAX // 4]:
+            _HM_CACHE.pop(k, None)
+    _HM_CACHE[key] = q
+    return q
+
+
+def verify_signature_slow(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Today's scalar pairing, verbatim (pre-ISSUE-9 PubKey.verify_signature
+    body): plain Miller loops, 2790-bit final-exponentiation ladder, uncached
+    hash-to-G2. The bench `agg` scalar arm and the fast-path equivalence
+    tests measure/check against THIS."""
+    if len(sig) != SIGNATURE_SIZE:
+        return False
+    try:
+        pk = g1_decompress(pub)
+        s = g2_unmarshal(sig)
+        if pk is None or s is None:
+            return False
+        hm = _hash_to_g2(msg)
+        neg_pk = (pk[0], (P - pk[1]) % P)
+        return pairing_check([(neg_pk, hm), (G1, s)])
+    except (ValueError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Aggregate BLS (ISSUE 9 tentpole): one G2 point for a whole commit.
+#
+# Trust model: sound for DISTINCT per-signer messages under proof-of-
+# possession of the registered validator keys (the standard BLS deployment
+# assumption; a rogue pubkey registered as pk' = [x]G1 - pk_victim could
+# otherwise claim the victim co-signed an identical message). Documented in
+# ops/DESIGN.md; CMTPU_AGG_COMMITS stays default-off.
+
+
+def aggregate_signatures(sigs) -> bytes:
+    """G2 sum of BLS signatures -> one 128-byte uncompressed point.
+
+    Every input is fully validated (on-curve + r-torsion) by g2_unmarshal;
+    a malformed signature raises ValueError rather than silently poisoning
+    the aggregate."""
+    total = None
+    for s in sigs:
+        total = _g2_add(total, g2_unmarshal(bytes(s)))
+    return g2_marshal(total)
+
+
+def verify_aggregate(pub_keys, msgs, agg_sig: bytes) -> bool:
+    """e(G1, agg) == prod_i e(pk_i, H(m_i)) as n+1 Miller loops sharing one
+    final exponentiation. pub_keys are compressed G1 bytes, msgs the
+    per-signer (distinct) messages."""
+    if len(pub_keys) != len(msgs) or not pub_keys:
+        return False
+    try:
+        s = g2_unmarshal(bytes(agg_sig))
+    except (ValueError, TypeError):
+        return False
+    pairs = []
+    for pb, m in zip(pub_keys, msgs):
+        try:
+            pk = g1_decompress(bytes(pb))
+        except (ValueError, TypeError):
+            return False
+        if pk is None:
+            return False
+        pairs.append(((pk[0], (P - pk[1]) % P), _hash_to_g2_cached(m)))
+    pairs.append((G1, s))
+    return pairing_check_fast(pairs)
+
+
+def verify_aggregate_slow(pub_keys, msgs, agg_sig: bytes) -> bool:
+    """Decision-identical slow-arithmetic form of verify_aggregate (plain
+    per-pair Miller loops + the 2790-bit final-exp ladder) — the anchor the
+    equivalence tests and the bench scalar arm compare against."""
+    if len(pub_keys) != len(msgs) or not pub_keys:
+        return False
+    try:
+        s = g2_unmarshal(bytes(agg_sig))
+        pairs = []
+        for pb, m in zip(pub_keys, msgs):
+            pk = g1_decompress(bytes(pb))
+            if pk is None:
+                return False
+            pairs.append(((pk[0], (P - pk[1]) % P), _hash_to_g2(m)))
+        pairs.append((G1, s))
+        return pairing_check(pairs)
+    except (ValueError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Batched per-signature verification with a bitmap (the BatchVerifier
+# protocol). A naive product check is UNSOUND for bitmap semantics — two bad
+# signatures can cancel (e(G1, s+d) * e(G1, s'-d) preserves the product) —
+# so each signature is weighted by an unpredictable 64-bit scalar derived
+# Fiat-Shamir-style from the whole batch:
+#     prod_i e([w_i](-pk_i), H(m_i)) * e(G1, sum_i [w_i] s_i) == 1
+# A cancellation would need the adversary to predict w_i before fixing the
+# signatures that determine them. On failure the check bisects to the exact
+# bad lanes (the per-sig bitmap the verify_commit error path needs).
+
+
+def _batch_weights(pubs, msgs, sigs):
+    h = hashlib.sha256()
+    for col in (pubs, msgs, sigs):
+        for x in col:
+            h.update(len(x).to_bytes(4, "big"))
+            h.update(x)
+    seed = h.digest()
+    return [
+        int.from_bytes(
+            hashlib.sha256(seed + i.to_bytes(4, "big")).digest()[:8], "big"
+        )
+        | 1
+        for i in range(len(pubs))
+    ]
+
+
+def batch_verify_signatures(pubs, msgs, sigs) -> tuple[bool, list]:
+    """(all_ok, per-sig bitmap) over raw byte columns — the host multi-
+    pairing engine behind Bn254HostBackend. Structurally invalid entries are
+    False lanes and never poison the rest."""
+    n = len(pubs)
+    bits = [False] * n
+    parsed: dict[int, tuple] = {}
+    for i in range(n):
+        try:
+            pk = g1_decompress(bytes(pubs[i]))
+            s = g2_unmarshal(bytes(sigs[i]))
+            if pk is None or s is None:
+                continue
+        except (ValueError, TypeError):
+            continue
+        parsed[i] = (
+            (pk[0], (P - pk[1]) % P),
+            _hash_to_g2_cached(bytes(msgs[i])),
+            s,
+        )
+    ws = _batch_weights(
+        [bytes(p) for p in pubs], [bytes(m) for m in msgs], [bytes(s) for s in sigs]
+    )
+
+    def check(idxs) -> bool:
+        pairs = []
+        agg = None
+        for i in idxs:
+            neg_pk, hm, s = parsed[i]
+            pairs.append((_g1_mul(ws[i], neg_pk), hm))
+            agg = _g2_add(agg, _g2_mul(ws[i], s))
+        pairs.append((G1, agg))
+        return pairing_check_fast(pairs)
+
+    stack = [sorted(parsed)] if parsed else []
+    while stack:
+        idxs = stack.pop()
+        if not idxs:
+            continue
+        if check(idxs):
+            for i in idxs:
+                bits[i] = True
+        elif len(idxs) == 1:
+            bits[idxs[0]] = False
+        else:
+            mid = len(idxs) // 2
+            stack.append(idxs[:mid])
+            stack.append(idxs[mid:])
+    return (n > 0 and all(bits)), bits
+
+
+# ---------------------------------------------------------------------------
+# Verification backends: the same VerifyBackend shape the ed25519 chain
+# speaks ((pubs, msgs, sigs) byte columns -> (ok, bitmap)), so the generic
+# CoalescingScheduler / ResilientBackend / ChaosBackend stack applies
+# unchanged. The bn254 chain is its OWN instance — the ed25519 singleton
+# cannot verify bn254 triples — with the same env knobs.
+
+
+class Bn254HostBackend:
+    """Randomized-weight multi-pairing with shared final exponentiation."""
+
+    name = "bn254-host"
+
+    def batch_verify(self, pubs, msgs, sigs):
+        return batch_verify_signatures(pubs, msgs, sigs)
+
+    def aggregate_verify(self, pubs, msgs, agg_sig) -> bool:
+        return verify_aggregate(pubs, msgs, agg_sig)
+
+    def merkle_root(self, leaves):
+        from cometbft_tpu.crypto import merkle
+
+        return merkle.hash_from_byte_slices(list(leaves))
+
+    def ping(self) -> bool:
+        return True
+
+
+class Bn254ScalarBackend:
+    """The chain anchor: independent scalar pairing checks, one per
+    signature — no shared state with the batched engines, so it is valid
+    crosscheck ground truth for them."""
+
+    name = "bn254-cpu"
+
+    def batch_verify(self, pubs, msgs, sigs):
+        bits = []
+        for p, m, s in zip(pubs, msgs, sigs):
+            bits.append(_scalar_verify(bytes(p), bytes(m), bytes(s)))
+        return (len(bits) > 0 and all(bits)), bits
+
+    def aggregate_verify(self, pubs, msgs, agg_sig) -> bool:
+        # The aggregate has no per-sig form; the anchor's check is the
+        # exact-integer host multi-pairing (same decision as the slow
+        # reference ladder, asserted by the equivalence tests).
+        return verify_aggregate(pubs, msgs, agg_sig)
+
+    def merkle_root(self, leaves):
+        from cometbft_tpu.crypto import merkle
+
+        return merkle.hash_from_byte_slices(list(leaves))
+
+    def ping(self) -> bool:
+        return True
+
+
+def _scalar_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """One pairing check (fast arithmetic, scalar semantics): the per-sig
+    anchor. Decision-identical to verify_signature_slow."""
+    if len(sig) != SIGNATURE_SIZE:
+        return False
+    try:
+        pk = g1_decompress(pub)
+        s = g2_unmarshal(sig)
+        if pk is None or s is None:
+            return False
+        hm = _hash_to_g2_cached(msg)
+        neg_pk = (pk[0], (P - pk[1]) % P)
+        return pairing_check_fast([(neg_pk, hm), (G1, s)])
+    except (ValueError, TypeError):
+        return False
+
+
+def build_bn254_chain():
+    """bn254-device -> bn254-host -> scalar-cpu anchor, with the same
+    CMTPU_FAULTS chaos wrapping rules as supervisor.build_chain (non-anchor
+    tiers only; cpu-only + faults inserts a chaos-wrapped host primary)."""
+    from cometbft_tpu.sidecar.chaos import ChaosBackend, faults_from_env
+
+    tiers = []
+    try:
+        from cometbft_tpu.ops import bn254_kernel as _bk
+
+        if _bk.device_available():
+            tiers.append(("bn254-device", _bk.Bn254DeviceBackend()))
+    except Exception:
+        pass  # no jax / kernel import failure: host tiers still serve
+    tiers.append(("bn254-host", Bn254HostBackend()))
+    faults = faults_from_env()
+    if faults:
+        seed = int(os.environ.get("CMTPU_FAULTS_SEED", "0") or 0)
+        tiers = [
+            (name, ChaosBackend(b, faults, seed=seed + i))
+            for i, (name, b) in enumerate(tiers)
+        ]
+    tiers.append(("cpu", Bn254ScalarBackend()))
+    return tiers
+
+
+_backend = None
+_backend_lock = None
+
+
+def get_bn254_backend():
+    """Process singleton mirroring sidecar.backend.get_backend(): under
+    CMTPU_BACKEND=auto the supervised chain behind the coalescer; any other
+    choice serves the bare host multi-pairing engine (always CPU-capable,
+    fails loudly — never a silent downgrade to per-sig verification)."""
+    global _backend, _backend_lock
+    if _backend is not None:
+        return _backend
+    import threading
+
+    if _backend_lock is None:
+        _backend_lock = threading.Lock()
+    with _backend_lock:
+        if _backend is not None:
+            return _backend
+        choice = os.environ.get("CMTPU_BACKEND", "auto").strip() or "auto"
+        if choice == "auto":
+            from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+            from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+            chain = ResilientBackend(build_bn254_chain())
+            if os.environ.get("CMTPU_COALESCE", "1") != "0":
+                _backend = CoalescingScheduler(chain)
+            else:
+                _backend = chain
+        else:
+            _backend = Bn254HostBackend()
+    return _backend
+
+
+def set_bn254_backend(b) -> None:
+    """Test/bench hook (None re-resolves lazily on next use)."""
+    global _backend
+    old = _backend
+    _backend = b
+    if old is not None and hasattr(old, "close") and old is not b:
+        try:
+            old.close()
+        except Exception:
+            pass
+
+
+# -- verified-triple cache (same contract as ed25519._verified) --------------
+
+_VERIFIED_MAX = int(os.environ.get("CMTPU_VERIFY_CACHE_MAX", "") or 131072)
+_verified: dict[tuple, None] = {}
+
+
+def _verified_put(key: tuple) -> None:
+    if key in _verified:
+        del _verified[key]
+    elif len(_verified) >= _VERIFIED_MAX:
+        for k in list(_verified)[: max(1, _VERIFIED_MAX // 4)]:
+            _verified.pop(k, None)
+    _verified[key] = None
+
+
+class BatchVerifier(crypto.BatchVerifier):
+    """crypto.BatchVerifier over bn254 triples: verified-triple LRU filter,
+    within-batch dedup, the supervised bn254 chain, per-sig scalar fallback
+    on ChainExhausted — the same lifecycle ed25519.BatchVerifier has."""
+
+    def __init__(self):
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, key, msg: bytes, sig: bytes) -> None:
+        if not isinstance(key, PubKey):
+            raise TypeError("bn254.BatchVerifier requires bn254 public keys")
+        if len(sig) != SIGNATURE_SIZE:
+            raise ValueError(f"bn254 signature must be {SIGNATURE_SIZE} bytes")
+        self._pubs.append(key.bytes())
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def count(self) -> int:
+        return len(self._pubs)
+
+    def verify(self) -> tuple[bool, list]:
+        n = len(self._pubs)
+        if n == 0:
+            return False, []
+        bits: list = [None] * n
+        first_at: dict[tuple, int] = {}
+        sub_idx: list[int] = []
+        for i in range(n):
+            key = (self._pubs[i], self._sigs[i], self._msgs[i])
+            if key in _verified:
+                bits[i] = True
+            elif key in first_at:
+                bits[i] = first_at[key]  # lane alias, resolved below
+            else:
+                first_at[key] = i
+                sub_idx.append(i)
+        if sub_idx:
+            sub_pubs = [self._pubs[i] for i in sub_idx]
+            sub_msgs = [self._msgs[i] for i in sub_idx]
+            sub_sigs = [self._sigs[i] for i in sub_idx]
+            from cometbft_tpu.sidecar.supervisor import ChainExhausted
+
+            try:
+                _, sub_bits = get_bn254_backend().batch_verify(
+                    sub_pubs, sub_msgs, sub_sigs
+                )
+                if len(sub_bits) != len(sub_idx):
+                    raise ValueError("backend returned wrong-shaped bitmap")
+            except ChainExhausted:
+                sub_bits = [
+                    _scalar_verify(p, m, s)
+                    for p, m, s in zip(sub_pubs, sub_msgs, sub_sigs)
+                ]
+            for j, i in enumerate(sub_idx):
+                bits[i] = bool(sub_bits[j])
+                if bits[i]:
+                    _verified_put((self._pubs[i], self._sigs[i], self._msgs[i]))
+        out = []
+        for b in bits:
+            if isinstance(b, bool):
+                out.append(b)
+            else:  # alias lane: int index of the first occurrence
+                out.append(bool(bits[b]))
+        return all(out), out
+
+# The name commit verification uses via crypto.batch's registry.
+Bn254BatchVerifier = BatchVerifier

@@ -56,7 +56,9 @@ def sample_profile(seconds: float = 5.0, hz: int = 100) -> str:
             stack = []
             f = frame
             while f is not None and len(stack) < 24:
-                stack.append(f"{f.f_code.co_filename}:{f.f_lineno}:{f.f_code.co_qualname}")
+                # co_qualname is 3.11+; co_name keeps 3.10 serving samples.
+                qn = getattr(f.f_code, "co_qualname", f.f_code.co_name)
+                stack.append(f"{f.f_code.co_filename}:{f.f_lineno}:{qn}")
                 f = f.f_back
             counts[tuple(reversed(stack))] += 1
         n += 1

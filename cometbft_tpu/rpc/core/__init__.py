@@ -85,7 +85,7 @@ def _header_json(h) -> dict:
 
 
 def _commit_json(c) -> dict:
-    return {
+    out = {
         "height": str(c.height),
         "round": c.round,
         "block_id": _block_id_json(c.block_id),
@@ -99,6 +99,10 @@ def _commit_json(c) -> dict:
             for s in c.signatures
         ],
     }
+    if c.agg_signature:
+        out["agg_signature"] = _b64(c.agg_signature)
+        out["agg_bitmap"] = _b64(c.agg_bitmap)
+    return out
 
 
 def _block_json(b) -> dict:

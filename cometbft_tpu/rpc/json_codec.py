@@ -75,6 +75,12 @@ def commit_from_json(d: dict) -> Commit:
         round=int(d.get("round", 0)),
         block_id=block_id_from_json(d.get("block_id")),
         signatures=sigs,
+        agg_signature=(
+            base64.b64decode(d["agg_signature"]) if d.get("agg_signature") else b""
+        ),
+        agg_bitmap=(
+            base64.b64decode(d["agg_bitmap"]) if d.get("agg_bitmap") else b""
+        ),
     )
 
 

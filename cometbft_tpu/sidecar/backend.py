@@ -116,6 +116,10 @@ class TpuBackend(VerifyBackend):
         # falls back to the level loop for ragged counts.
         return self._merkle.merkle_root_fused(leaves)
 
+    def mesh_width(self) -> int:
+        # Safe to probe: constructing this tier already ran jax.devices().
+        return self._ed.mesh_width()
+
 
 class HybridBackend(VerifyBackend):
     """Device + host tiers working the same batch concurrently.
@@ -377,6 +381,9 @@ class HybridBackend(VerifyBackend):
         if self._native.ready() is not None:
             return self._native.merkle_root(leaves)
         return self._tpu.merkle_root(leaves)
+
+    def mesh_width(self) -> int:
+        return self._n_dev
 
     def verify_and_root(self, pubs, msgs, sigs, leaves):
         """The commit-verification + block-tree fusion: device share in

@@ -137,6 +137,18 @@ class ChaosBackend(VerifyBackend):
             return True, [True] * len(pubs)
         return ok, bits
 
+    def aggregate_verify(self, pubs, msgs, agg_sig):
+        self._pre_call()
+        ok = self.inner.aggregate_verify(pubs, msgs, agg_sig)
+        hit, _ = self._draw("flip")
+        if hit:
+            # An aggregate verdict is ONE boolean, so the false-accept
+            # corruption is a plain inversion-to-True; the supervisor's
+            # anchor recompute must catch it (there is no per-lane sample
+            # granularity to catch it cheaper).
+            return True
+        return ok
+
     def merkle_root(self, leaves):
         self._pre_call()
         return self.inner.merkle_root(leaves)

@@ -141,6 +141,7 @@ class CoalescingScheduler(VerifyBackend):
             if window_ms is None
             else window_ms
         )
+        self._cap_auto = False
         if max_sigs is not None:
             self.max_sigs = max_sigs
         elif os.environ.get("CMTPU_COALESCE_MAX", ""):
@@ -148,7 +149,11 @@ class CoalescingScheduler(VerifyBackend):
         else:
             # Pod-width default: one merged dispatch can fill every chip
             # (16384 lanes each — the single-chip cap this generalizes).
-            # An explicit env or arg always wins.
+            # An explicit env or arg always wins. The auto cap re-reads the
+            # chain's width periodically (refresh_cap) because the width a
+            # grpc tier serves is only learned from the sidecar's Ping
+            # capability reply AFTER the first connect.
+            self._cap_auto = True
             self.max_sigs = 16384 * max(1, _mesh_width_for_cap())
         self._queue: list[_Request] = []
         self._cond = threading.Condition()
@@ -189,10 +194,33 @@ class CoalescingScheduler(VerifyBackend):
     def batch_verify(self, pubs, msgs, sigs):
         return self.submit(pubs, msgs, sigs).result()
 
+    def aggregate_verify(self, pubs, msgs, agg_sig):
+        # One boolean per whole commit: nothing to slice across callers;
+        # pass straight through to the supervised chain.
+        return self.inner.aggregate_verify(pubs, msgs, agg_sig)
+
     def merkle_root(self, leaves):
         # Roots carry no cross-caller coalescing opportunity (one tree per
         # call); pass straight through to the chain.
         return self.inner.merkle_root(leaves)
+
+    def mesh_width(self) -> int:
+        mw = getattr(self.inner, "mesh_width", None)
+        return int(mw()) if mw is not None else 1
+
+    def refresh_cap(self) -> int:
+        """Re-derive the auto merge cap from the chain's CURRENT width
+        (local chips, or a remote pod's once the sidecar Ping capability
+        reply has been seen). Pinned caps (arg/env) never move."""
+        if self._cap_auto:
+            try:
+                width = max(1, self.mesh_width())
+            except Exception:
+                return self.max_sigs
+            new_cap = 16384 * width
+            if new_cap > self.max_sigs:
+                self.max_sigs = new_cap
+        return self.max_sigs
 
     def ping(self):
         inner_ping = getattr(self.inner, "ping", None)
@@ -254,6 +282,15 @@ class CoalescingScheduler(VerifyBackend):
     def _dispatch(self, batch: list[_Request]) -> None:
         with self._cond:
             self.counters_["dispatches"] += 1
+            refresh = self._cap_auto and self.counters_["dispatches"] % 64 == 1
+        if refresh:
+            # Cheap cached-width read (no dial): pick up a remote pod's
+            # width once the grpc tier has seen a Ping capability reply.
+            try:
+                self.refresh_cap()
+            except Exception:
+                pass
+        with self._cond:
             if len(batch) > 1:
                 self.counters_["coalesced_dispatches"] += 1
                 self.counters_["batched_requests"] += len(batch)

@@ -143,7 +143,21 @@ class SidecarServer:
 
     def _dispatch(self, method: str, payload: bytes) -> bytes:
         if method == "Ping":
-            return b"pong"
+            # Capability reply: PingResp { 1: "pong", 2: mesh_width }.
+            # The width is the REMOTE pod's chip count, so client-side
+            # sizing (the coalescer's default merge cap, chain pricing)
+            # sees the serving mesh, not the local host's. Legacy clients
+            # that compared the raw body to b"pong" must upgrade with the
+            # server; new clients still accept a bare b"pong" from an old
+            # server (width defaults to 1).
+            width = 1
+            mw = getattr(self.backend, "mesh_width", None)
+            if mw is not None:
+                try:
+                    width = max(1, int(mw()))
+                except Exception:
+                    width = 1
+            return proto.field_bytes(1, b"pong") + proto.field_varint(2, width)
         if method == "BatchVerify":
             fields = proto.decode_fields(payload)
             pubs = proto.get_repeated_bytes(fields, 1)
@@ -233,6 +247,8 @@ class GrpcBackend(VerifyBackend):
         # call after the window redials.
         self._redial_failures = 0
         self._redial_not_before = 0.0
+        # Remote pod width from the Ping capability reply (1 until probed).
+        self._remote_mesh_width = 1
 
     def _connect_locked(self) -> None:
         now = time.monotonic()
@@ -354,7 +370,25 @@ class GrpcBackend(VerifyBackend):
         return proto.get_bytes(fields, 4)
 
     def ping(self) -> bool:
-        return self._call("Ping", b"") == b"pong"
+        body = self._call("Ping", b"")
+        if body == b"pong":  # pre-capability server
+            return True
+        try:
+            fields = proto.decode_fields(body)
+            if proto.get_bytes(fields, 1) != b"pong":
+                return False
+            width = proto.get_uvarint(fields, 2)
+            if width:
+                self._remote_mesh_width = int(width)
+            return True
+        except Exception:
+            return False
+
+    def mesh_width(self) -> int:
+        """The serving pod's chip count, learned from the Ping capability
+        reply. Never dials: an unpinged client reports 1 and the caller's
+        periodic refresh picks the real width up after the first probe."""
+        return self._remote_mesh_width
 
     def batch_verify(self, pubs, msgs, sigs):
         payload = b"".join(

@@ -354,10 +354,83 @@ class ResilientBackend(VerifyBackend):
         ok, bits, *_ = self._call("batch_verify", fn_for, crosscheckable=True)
         return ok, bits
 
+    def aggregate_verify(self, pubs, msgs, agg_sig) -> bool:
+        """One boolean over a whole aggregate-BLS commit (bn254 chain).
+
+        Same walk as batch_verify — deadline, retry, breaker — but tiers
+        that don't speak the verb are SKIPPED, not failed (the verb must
+        not trip breakers on a chain that never advertised it). The
+        crosscheck differs by necessity: an aggregate verdict has no
+        per-lane sample granularity, so any non-off CMTPU_CROSSCHECK
+        recomputes the WHOLE check on the anchor when a non-anchor tier
+        served it — a flipped accept from a sick tier is caught, counted,
+        and trips the tier, exactly like a bitmap flip would be."""
+        with self._lock:
+            self.counters_["calls"] += 1
+        last_err: Exception | None = None
+        speakers = [
+            (i, t)
+            for i, t in enumerate(self.tiers)
+            if getattr(t.backend, "aggregate_verify", None) is not None
+        ]
+        if not speakers:
+            raise ChainExhausted("aggregate_verify: no tier speaks the verb")
+        for j, (i, tier) in enumerate(speakers):
+            anchored = j == len(speakers) - 1
+            if not self._admit(tier):
+                continue
+            if tier.state == _HALF_OPEN and not self._probe(tier):
+                self._record_failure(tier)
+                continue
+            tier.calls += 1
+            try:
+                result = self._run_on(
+                    tier,
+                    lambda b=tier.backend: b.aggregate_verify(pubs, msgs, agg_sig),
+                    anchored=anchored,
+                )
+            except Exception as e:
+                last_err = e
+                self._record_failure(tier)
+                continue
+            if not anchored and self.crosscheck != "off":
+                anchor = speakers[-1][1].backend
+                if bool(result) != bool(
+                    anchor.aggregate_verify(pubs, msgs, agg_sig)
+                ):
+                    with self._lock:
+                        self.counters_["crosscheck_catches"] += 1
+                    self._record_failure(tier)
+                    continue
+            self._record_success(tier)
+            if i > 0:
+                with self._lock:
+                    self.counters_["degraded_calls"] += 1
+            return bool(result)
+        raise ChainExhausted(
+            "aggregate_verify: every tier failed "
+            f"({', '.join(t.name for _, t in speakers)})"
+        ) from last_err
+
     def merkle_root(self, leaves):
         return self._call(
             "merkle_root", lambda backend: lambda: backend.merkle_root(leaves)
         )
+
+    def mesh_width(self) -> int:
+        """Widest mesh any tier can reach — local chips (hybrid/tpu tiers)
+        or a remote pod's (the grpc tier's Ping capability reply). The
+        coalescer sizes its default merge cap from this."""
+        width = 1
+        for tier in self.tiers:
+            mw = getattr(tier.backend, "mesh_width", None)
+            if mw is None:
+                continue
+            try:
+                width = max(width, int(mw()))
+            except Exception:
+                continue
+        return width
 
     def ping(self) -> bool:
         return bool(

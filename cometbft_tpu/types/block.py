@@ -287,8 +287,10 @@ class CommitSig:
             signature=wire.get_bytes(f, 4),
         )
 
-    def validate_basic(self) -> None:
-        """types/block.go:700-740."""
+    def validate_basic(self, aggregated: bool = False) -> None:
+        """types/block.go:700-740. aggregated=True is the ISSUE-9 wire form:
+        the signature bytes live in the commit-level aggregate, so a
+        non-absent entry must carry an EMPTY per-sig column."""
         if self.block_id_flag not in (
             BLOCK_ID_FLAG_ABSENT,
             BLOCK_ID_FLAG_COMMIT,
@@ -305,13 +307,24 @@ class CommitSig:
         else:
             if len(self.validator_address) != tmhash.TRUNCATED_SIZE:
                 raise ValueError("expected ValidatorAddress size to be 20 bytes")
-            if not self.signature:
-                raise ValueError("signature is missing")
-            if len(self.signature) > MAX_SIGNATURE_SIZE:
-                raise ValueError("signature is too big")
+            if aggregated:
+                if self.signature:
+                    raise ValueError(
+                        "per-signature bytes present in aggregate commit"
+                    )
+            else:
+                if not self.signature:
+                    raise ValueError("signature is missing")
+                if len(self.signature) > MAX_SIGNATURE_SIZE:
+                    raise ValueError("signature is too big")
 
 
-MAX_SIGNATURE_SIZE = 96  # types/signable.go MaxSignatureSize (bn254 G2 = 96)
+# types/signable.go MaxSignatureSize is 96, sized for compressed bn254 G2;
+# this rebuild's bn254 signatures are UNCOMPRESSED G2 (crypto/bn254.py
+# SIGNATURE_SIZE = 128), so per-vote bn254 commits need the extra room.
+MAX_SIGNATURE_SIZE = 128
+# Aggregate-commit wire form (ISSUE 9): one uncompressed bn254 G2 sum.
+AGG_SIGNATURE_SIZE = 128
 
 
 @dataclass
@@ -322,12 +335,28 @@ class Commit:
     round: int = 0
     block_id: BlockID = dfield(default_factory=BlockID)
     signatures: list = dfield(default_factory=list)
+    # Aggregate wire form (ISSUE 9, CMTPU_AGG_COMMITS): one G2 sum over every
+    # non-absent signature plus a signer bitmap; the per-sig columns above
+    # are then empty. Both empty = today's per-vote form, byte-identical on
+    # the wire (fields 5/6 are simply not emitted).
+    agg_signature: bytes = b""
+    agg_bitmap: bytes = b""
     _hash: bytes | None = dfield(default=None, compare=False, repr=False)
     _sb_cache: tuple | None = dfield(default=None, compare=False, repr=False)
     _sba_cache: tuple | None = dfield(default=None, compare=False, repr=False)
 
     def size(self) -> int:
         return len(self.signatures)
+
+    def is_aggregate(self) -> bool:
+        return bool(self.agg_signature)
+
+    def agg_signer(self, idx: int) -> bool:
+        """Whether validator idx's signature is folded into agg_signature."""
+        byte = idx >> 3
+        if byte >= len(self.agg_bitmap):
+            return False
+        return bool(self.agg_bitmap[byte] & (1 << (idx & 7)))
 
     def hash(self) -> bytes:
         if self._hash is None:
@@ -501,6 +530,9 @@ class Commit:
         out += wire.field_message(3, self.block_id.encode(), emit_empty=True)
         for cs in self.signatures:
             out += wire.field_message(4, cs.encode(), emit_empty=True)
+        if self.agg_signature:
+            out += wire.field_bytes(5, self.agg_signature)
+            out += wire.field_bytes(6, self.agg_bitmap)
         return out
 
     @classmethod
@@ -511,24 +543,98 @@ class Commit:
             round=wire.get_varint(f, 2),
             block_id=BlockID.decode(wire.get_bytes(f, 3)),
             signatures=[CommitSig.decode(b) for b in wire.get_repeated_bytes(f, 4)],
+            agg_signature=wire.get_bytes(f, 5),
+            agg_bitmap=wire.get_bytes(f, 6),
         )
 
     def validate_basic(self) -> None:
-        """types/block.go:860-893."""
+        """types/block.go:860-893, plus the aggregate-form consistency rules:
+        the bitmap must mirror the non-absent entries exactly, every per-sig
+        column must be empty, and the G2 point is a fixed 128 bytes."""
         if self.height < 0:
             raise ValueError("negative Height")
         if self.round < 0:
             raise ValueError("negative Round")
+        if self.agg_bitmap and not self.agg_signature:
+            raise ValueError("aggregate bitmap without aggregate signature")
         if self.height >= 1:
             if self.block_id.is_zero():
                 raise ValueError("commit cannot be for nil block")
             if not self.signatures:
                 raise ValueError("no signatures in commit")
+            aggregated = self.is_aggregate()
+            if aggregated:
+                if len(self.agg_signature) != AGG_SIGNATURE_SIZE:
+                    raise ValueError(
+                        "aggregate signature must be 128 bytes (bn254 G2)"
+                    )
+                n = len(self.signatures)
+                if len(self.agg_bitmap) != (n + 7) // 8:
+                    raise ValueError("aggregate bitmap length mismatch")
+                if n % 8 and self.agg_bitmap[-1] >> (n % 8):
+                    raise ValueError(
+                        "aggregate bitmap has bits past the validator count"
+                    )
             for i, cs in enumerate(self.signatures):
                 try:
-                    cs.validate_basic()
+                    cs.validate_basic(aggregated=aggregated)
                 except ValueError as e:
                     raise ValueError(f"wrong CommitSig #{i}: {e}") from e
+                if aggregated and self.agg_signer(i) == cs.is_absent():
+                    raise ValueError(
+                        f"aggregate bitmap disagrees with CommitSig #{i}"
+                    )
+
+
+def aggregate_commit(commit: "Commit", vals) -> "Commit":
+    """Compress a per-vote commit into the aggregate wire form (one G2 sum +
+    a signer bitmap) when every participating validator key is bn254
+    (CMTPU_AGG_COMMITS call sites). Anything else — mixed key types, a
+    malformed signature, an empty commit — returns the input unchanged: the
+    per-vote form is always valid, so this can only shrink the wire.
+
+    Only the block-embedded LastCommit goes through here; the locally stored
+    seen commit keeps per-vote signatures so restart reconstruction
+    (consensus._reconstruct_last_commit_if_needed) can rebuild the VoteSet.
+    """
+    from cometbft_tpu.crypto import bn254
+
+    if commit.agg_signature or not commit.signatures or vals is None:
+        return commit
+    if vals.size() != len(commit.signatures):
+        return commit
+    raw: list = []
+    bitmap = bytearray((len(commit.signatures) + 7) // 8)
+    for i, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        pk = vals.validators[i].pub_key
+        if pk is None or pk.type() != bn254.KEY_TYPE:
+            return commit
+        raw.append(cs.signature)
+        bitmap[i >> 3] |= 1 << (i & 7)
+    if not raw:
+        return commit
+    try:
+        agg = bn254.aggregate_signatures(raw)
+    except (ValueError, TypeError):
+        # An admitted vote with an unparseable signature would be a bug
+        # upstream; never let it block block production — ship per-vote.
+        return commit
+    stripped = [
+        cs
+        if cs.is_absent()
+        else CommitSig(cs.block_id_flag, cs.validator_address, cs.timestamp, b"")
+        for cs in commit.signatures
+    ]
+    return Commit(
+        height=commit.height,
+        round=commit.round,
+        block_id=commit.block_id,
+        signatures=stripped,
+        agg_signature=agg,
+        agg_bitmap=bytes(bitmap),
+    )
 
 
 # SignedMsgType values (proto/tendermint/types/types.proto).

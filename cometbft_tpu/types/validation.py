@@ -49,10 +49,32 @@ class ErrInvalidCommitSignatures(Exception):
         )
 
 
+def _batch_key_type(vals, commit: Commit) -> str | None:
+    """The single key type shared by EVERY validator in the set, if that
+    type is batch-capable — else None. The reference keys this decision on
+    the proposer alone (validation.go:145-150), which mis-batches a mixed
+    set: a bn254 signature fed into the ed25519 batch engine is a type
+    error, not a clean reject. Homogeneous sets batch; mixed sets fall back
+    to the per-signature scalar engine, which dispatches per key."""
+    if len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        return None
+    kt = None
+    for val in vals.validators:
+        pk = val.pub_key
+        if pk is None:
+            return None
+        t = pk.type()
+        if kt is None:
+            kt = t
+        elif t != kt:
+            return None
+    if kt is None or not crypto_batch.supports_batch_verifier(kt):
+        return None
+    return kt
+
+
 def _should_batch_verify(vals, commit: Commit) -> bool:
-    return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and (
-        crypto_batch.supports_batch_verifier(vals.get_proposer().pub_key)
-    )
+    return _batch_key_type(vals, commit) is not None
 
 
 def verify_commit(chain_id: str, vals, block_id: BlockID, height: int, commit: Commit) -> None:
@@ -62,7 +84,11 @@ def verify_commit(chain_id: str, vals, block_id: BlockID, height: int, commit: C
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: c.is_absent()
     count = lambda c: c.for_block_flag()
-    if _should_batch_verify(vals, commit):
+    if commit.is_aggregate():
+        _verify_commit_aggregate(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True
+        )
+    elif _should_batch_verify(vals, commit):
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count, True, True
         )
@@ -80,7 +106,11 @@ def verify_commit_light(
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: not c.for_block_flag()
     count = lambda c: True
-    if _should_batch_verify(vals, commit):
+    if commit.is_aggregate():
+        _verify_commit_aggregate(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True
+        )
+    elif _should_batch_verify(vals, commit):
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count, False, True
         )
@@ -112,13 +142,101 @@ def verify_commit_light_trusting(
     voting_power_needed = total_mul // trust_level.denominator
     ignore = lambda c: not c.for_block_flag()
     count = lambda c: True
-    if _should_batch_verify(vals, commit):
+    if commit.is_aggregate():
+        _verify_commit_aggregate(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False
+        )
+    elif _should_batch_verify(vals, commit):
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count, False, False
         )
     else:
         _verify_commit_single(
             chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+
+
+def _verify_commit_aggregate(
+    chain_id: str,
+    vals,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    look_up_by_index: bool,
+) -> None:
+    """One pairing product stands in for every per-signature check (ISSUE 9).
+
+    The aggregate is indivisible, so the semantics are deliberately stricter
+    than the per-vote engines: the bitmap must mirror the non-absent entries
+    exactly, every aggregated signer must resolve to a bn254 key in the
+    verifying set, and the whole product is checked even in the light modes
+    (there is no "stop at quorum" for a single G2 sum — nil votes ride along,
+    which can only make acceptance stricter, never a wrong-accept). A reject
+    is loud: there is no silent downgrade to scalar verification, because a
+    poisoned aggregate has no per-signature form to fall back to.
+
+    In trusting mode (look_up_by_index=False) a signer outside the trusted
+    set leaves the product uncheckable — that raises, and the light client
+    degrades to bisection exactly as it does for any failed trusting check.
+    """
+    from cometbft_tpu.crypto import bn254
+
+    n = len(commit.signatures)
+    if len(commit.agg_bitmap) != (n + 7) // 8:
+        raise ValueError("aggregate bitmap length mismatch")
+    seen_vals: dict[int, int] = {}
+    pubs: list[bytes] = []
+    msgs: list[bytes] = []
+    tallied = 0
+    all_sign_bytes = commit.vote_sign_bytes_all(chain_id)
+    for idx, commit_sig in enumerate(commit.signatures):
+        in_agg = commit.agg_signer(idx)
+        if commit_sig.is_absent():
+            if in_agg:
+                raise ValueError(
+                    f"aggregate bitmap set for absent CommitSig #{idx}"
+                )
+            continue
+        if not in_agg:
+            raise ValueError(
+                f"aggregate bitmap clear for signed CommitSig #{idx}"
+            )
+        if commit_sig.signature:
+            raise ValueError(
+                f"per-signature bytes present in aggregate commit (#{idx})"
+            )
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                raise ValueError(
+                    f"aggregate commit signer #{idx} unknown to the verifying set"
+                )
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        pk = val.pub_key
+        if pk is None or pk.type() != bn254.KEY_TYPE:
+            raise ValueError(
+                f"aggregate commit requires bn254 keys (validator #{idx})"
+            )
+        pubs.append(pk.bytes())
+        msgs.append(all_sign_bytes[idx])
+        if not ignore_sig(commit_sig) and count_sig(commit_sig):
+            tallied += val.voting_power
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(tallied, voting_power_needed)
+    if not pubs:
+        raise ValueError("aggregate commit with no signers")
+    if not bn254.get_bn254_backend().aggregate_verify(
+        pubs, msgs, commit.agg_signature
+    ):
+        raise ValueError(
+            f"invalid aggregate signature for commit at height {commit.height}"
         )
 
 
@@ -133,14 +251,12 @@ def _verify_commit_batch(
     look_up_by_index: bool,
 ) -> None:
     """types/validation.go:152-256 — the TPU call site."""
-    try:
-        bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
-    except ValueError:
-        bv = None
-    if bv is None or len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+    kt = _batch_key_type(vals, commit)
+    if kt is None:
         raise ValueError(
             "unsupported signature algorithm or insufficient signatures for batch verification"
         )
+    bv = crypto_batch.create_batch_verifier(kt)
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0
@@ -247,6 +363,8 @@ def speculative_verify_triples(
 
     if commit is None or untrusted_vals is None:
         return []
+    if commit.is_aggregate():
+        return []  # one pairing product; no per-sig triples to prewarm
     if untrusted_vals.size() != len(commit.signatures):
         return []  # light check will reject this hop; nothing to prewarm
     light_needed = untrusted_vals.total_voting_power() * 2 // 3

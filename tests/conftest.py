@@ -85,3 +85,11 @@ def pytest_configure(config):
         "pod-width coalescer cap, dryrun_multichip) on the 8-device "
         "virtual mesh; runs in tier-1 — `-m mesh` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "agg: aggregate BLS commit tests (BN254 aggregate wire form, "
+        "three-mode verify bit-parity, poisoned-aggregate rejection, "
+        "device multi-pairing kernel); fast paths run in tier-1, the "
+        "kernel-compile test carries `slow` too — `-m agg` selects "
+        "just this group",
+    )

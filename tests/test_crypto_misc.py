@@ -103,11 +103,14 @@ class TestBn254:
         msg = b"zk"
         assert not priv.pub_key().verify_signature(msg, other.sign(msg))
 
-    def test_no_batch_support(self):
+    def test_batch_support(self):
+        # PR 9 flipped this: bn254 joined the batch registry (randomized-
+        # weight multi-pairing), so the reference's "no batch verification
+        # for BLS" delta no longer holds here.
         priv = bn254.gen_priv_key()
-        assert not batch.supports_batch_verifier(priv.pub_key())
-        with pytest.raises(ValueError):
-            batch.create_batch_verifier(priv.pub_key())
+        assert batch.supports_batch_verifier(priv.pub_key())
+        assert isinstance(batch.create_batch_verifier(priv.pub_key()),
+                          bn254.BatchVerifier)
 
 
 class TestBatchDispatch:

@@ -8,7 +8,11 @@ import os
 import pytest
 
 from cometbft_tpu.cmd.__main__ import main as cli
-from cometbft_tpu.privval.file import KEY_TYPES, FilePV
+from cometbft_tpu.privval.file import KEY_TYPES, DoubleSignError, FilePV
+from cometbft_tpu.types.block import PRECOMMIT_TYPE, BlockID
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.vote import Vote
 
 
 @pytest.mark.parametrize("key_type", KEY_TYPES)
@@ -73,6 +77,42 @@ def test_testnet_cycles_key_types_and_non_validators(tmp_path):
     }
     with open(os.path.join(out, "node4", "config", "genesis.json")) as f:
         assert json.load(f) == genesis
+
+
+@pytest.mark.agg
+def test_bn254_sign_state_recovers_across_restart(tmp_path):
+    """A bn254 validator's sign state must survive a restart exactly like
+    ed25519's: the reloaded FilePV re-serves the saved signature for the
+    same vote and refuses a conflicting one at the same HRS — double-sign
+    protection is key-type independent."""
+    key_file = str(tmp_path / "key.json")
+    state_file = str(tmp_path / "state.json")
+    pv = FilePV.generate(key_file, state_file, key_type="bn254")
+    pv.save()
+    bid = BlockID(b"a" * 32, PartSetHeader(1, b"b" * 32))
+    vote = Vote(
+        type=PRECOMMIT_TYPE, height=3, round=0, block_id=bid,
+        timestamp=Time(1700000000, 0),
+        validator_address=pv.address(), validator_index=0,
+    )
+    signed = pv.sign_vote("agg-chain", vote)
+    assert len(signed.signature) == 128  # uncompressed G2
+
+    reloaded = FilePV.load(key_file, state_file)
+    assert reloaded.priv_key.type() == "bn254"
+    # Same vote after restart: the persisted signature is re-served (no
+    # second G2 signing, byte-identical output).
+    again = reloaded.sign_vote("agg-chain", vote)
+    assert again.signature == signed.signature
+    # A conflicting block at the same HRS must be refused.
+    other = Vote(
+        type=PRECOMMIT_TYPE, height=3, round=0,
+        block_id=BlockID(b"c" * 32, PartSetHeader(1, b"d" * 32)),
+        timestamp=Time(1700000000, 0),
+        validator_address=pv.address(), validator_index=0,
+    )
+    with pytest.raises(DoubleSignError):
+        reloaded.sign_vote("agg-chain", other)
 
 
 def test_testnet_rejects_unknown_key_type(tmp_path, capsys):

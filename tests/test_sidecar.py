@@ -303,3 +303,94 @@ def test_redial_succeeds_after_window_when_server_returns():
             server.shutdown()
     finally:
         client.close()
+
+
+# -- Ping capability reply: the serving pod's mesh width ---------------------
+
+
+class _WideCpuBackend(CpuBackend):
+    """A sidecar backend fronting an (imaginary) 8-chip pod."""
+
+    def mesh_width(self) -> int:
+        return 8
+
+
+def test_ping_reply_carries_remote_mesh_width():
+    addr = f"127.0.0.1:{_free_port()}"
+    server = SidecarServer(addr, backend=_WideCpuBackend()).start()
+    client = GrpcBackend(addr, timeout_s=10)
+    try:
+        assert client.mesh_width() == 1  # unprobed: never dials on its own
+        assert client.ping()
+        assert client.mesh_width() == 8  # learned from the capability reply
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_ping_accepts_legacy_bare_pong(monkeypatch):
+    # An old server answers the raw b"pong" body; the upgraded client must
+    # treat that as healthy and leave the width at its unprobed default.
+    client = GrpcBackend("127.0.0.1:1", timeout_s=1)
+    monkeypatch.setattr(client, "_call", lambda method, payload: b"pong")
+    assert client.ping()
+    assert client.mesh_width() == 1
+
+
+class _WidthStubBackend:
+    """Minimal VerifyBackend with a settable width (no crypto involved)."""
+
+    name = "stub"
+
+    def __init__(self, width=1):
+        self.width = width
+
+    def mesh_width(self) -> int:
+        return self.width
+
+    def batch_verify(self, pubs, msgs, sigs):
+        return True, [True] * len(pubs)
+
+    def merkle_root(self, leaves):
+        return hash_from_byte_slices(list(leaves))
+
+    def ping(self) -> bool:
+        return True
+
+
+def test_supervisor_mesh_width_is_widest_tier():
+    from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+    sup = ResilientBackend(
+        [("grpc", _WidthStubBackend(4)), ("cpu", _WidthStubBackend(1))],
+        crosscheck="off",
+    )
+    try:
+        assert sup.mesh_width() == 4
+    finally:
+        sup.close()
+
+
+def test_coalescer_auto_cap_refreshes_from_width(monkeypatch):
+    # The auto merge cap must follow the chain's width as a grpc tier
+    # learns its pod's size from Ping — and never shrink back.
+    from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+
+    monkeypatch.delenv("CMTPU_COALESCE_MAX", raising=False)
+    inner = _WidthStubBackend(1)
+    sched = CoalescingScheduler(inner)
+    initial = sched.max_sigs
+    assert initial % 16384 == 0
+    inner.width = (initial // 16384) * 2  # the remote pod is wider
+    assert sched.refresh_cap() == 16384 * inner.width
+    inner.width = 1  # a narrower reading later must not shrink the cap
+    assert sched.refresh_cap() == sched.max_sigs
+    sched.close()
+
+
+def test_coalescer_pinned_cap_never_moves():
+    from cometbft_tpu.sidecar.scheduler import CoalescingScheduler
+
+    sched = CoalescingScheduler(_WidthStubBackend(8), max_sigs=99)
+    assert sched.refresh_cap() == 99 and sched.max_sigs == 99
+    sched.close()

@@ -93,6 +93,12 @@ parts.append(
     + (" bit-identical"
        if m.get("calibration", {}).get("sharded_bit_identical") else "")
     if m else "mesh absent")
+a = rec.get("stages", {}).get("agg")
+parts.append(
+    f"agg {a.get('speedup_device_vs_scalar', a['host_aggregate']['speedup_vs_scalar'])}x "
+    f"wire {a['wire']['aggregate_vs_ed25519'] * 100:.2f}%"
+    + (" verified" if a.get("device", {}).get("reject_ok") else "")
+    if a else "agg absent")
 print("; ".join(parts))
 PYEOF
       )
