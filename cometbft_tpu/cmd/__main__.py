@@ -34,6 +34,16 @@ def _load_config(home: str):
     return apply_env_overrides(cfg)
 
 
+def _genesis_pop(pv) -> bytes:
+    """Proof of possession for a genesis validator's key: required for
+    bn254 (rogue-key defence at registration), empty for everything else."""
+    from cometbft_tpu.crypto import bn254
+
+    if pv.priv_key.type() != bn254.KEY_TYPE:
+        return b""
+    return bn254.prove_possession(pv.priv_key)
+
+
 def cmd_version(args) -> int:
     from cometbft_tpu.version import VERSION
 
@@ -61,7 +71,9 @@ def cmd_init(args) -> int:
         doc = GenesisDoc(
             chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
             genesis_time=cmttime.now(),
-            validators=[GenesisValidator(pub.address(), pub, 10, "")],
+            validators=[
+                GenesisValidator(pub.address(), pub, 10, "", _genesis_pop(pv))
+            ],
         )
         doc.validate_and_complete()
         doc.save_as(genesis_path)
@@ -168,7 +180,13 @@ def cmd_devnet(args) -> int:
         chain_id="devnet",
         genesis_time=cmttime.now(),
         validators=[
-            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 10, f"v{i}")
+            GenesisValidator(
+                pv.get_pub_key().address(),
+                pv.get_pub_key(),
+                10,
+                f"v{i}",
+                _genesis_pop(pv),
+            )
             for i, pv in enumerate(pvs)
         ],
     )
@@ -531,7 +549,13 @@ def cmd_testnet(args) -> int:
         chain_id=args.chain_id or "testnet",
         genesis_time=cmttime.now(),
         validators=[
-            GenesisValidator(pv.get_pub_key().address(), pv.get_pub_key(), 1, f"node{i}")
+            GenesisValidator(
+                pv.get_pub_key().address(),
+                pv.get_pub_key(),
+                1,
+                f"node{i}",
+                _genesis_pop(pv),
+            )
             for i, pv in enumerate(pvs[:n])
         ],
     )

@@ -23,6 +23,10 @@ KEY_TYPE = "bn254"
 PUB_KEY_SIZE = 32
 PRIV_KEY_SIZE = 64  # fr scalar (32) || compressed pubkey (32), mirrors sizePrivateKey
 SIGNATURE_SIZE = 128
+# Compressed G2 (gnark-style: x only, 2-bit flag selecting the y root).
+# Per-vote signatures stay uncompressed on the hot path; the 64-byte form is
+# the wire encoding of the per-block aggregate under CMTPU_AGG_COMMITS.
+SIGNATURE_SIZE_COMPRESSED = 64
 
 PRIV_KEY_NAME = "tendermint/PrivKeyBn254"
 PUB_KEY_NAME = "tendermint/PubKeyBn254"
@@ -471,7 +475,54 @@ def g2_marshal(q) -> bytes:
     )
 
 
+def g2_compress(q) -> bytes:
+    """Compressed G2: x.a1 || x.a0 big-endian (64 bytes) with the gnark
+    2-bit flag in the top bits of the first byte selecting which square
+    root of y² the point carries (lexicographically larger = (y1, y0) >
+    (-y1, -y0), matching gnark's Fp2 ordering)."""
+    if q is None:
+        out = bytearray(64)
+        out[0] = _COMPRESSED_INFINITY
+        return bytes(out)
+    (x0, x1), (y0, y1) = q[0], q[1]
+    out = bytearray(x1.to_bytes(32, "big") + x0.to_bytes(32, "big"))
+    neg = ((P - y1) % P, (P - y0) % P)
+    flag = _COMPRESSED_LARGEST if (y1, y0) > neg else _COMPRESSED_SMALLEST
+    out[0] |= flag
+    return bytes(out)
+
+
+def g2_decompress(b: bytes):
+    if len(b) != 64:
+        raise ValueError("bad G2 compressed length")
+    flag = b[0] & _MASK
+    if flag == _COMPRESSED_INFINITY:
+        if (b[0] & ~_MASK) or any(b[1:]):
+            raise ValueError("bad G2 infinity encoding")
+        return None
+    if flag not in (_COMPRESSED_SMALLEST, _COMPRESSED_LARGEST):
+        raise ValueError("bad G2 compression flag")
+    x1 = int.from_bytes(bytes([b[0] & ~_MASK]) + b[1:32], "big")
+    x0 = int.from_bytes(b[32:64], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 coordinate out of range")
+    x = (x0, x1)
+    y2 = f2_add(f2_mul(f2_sqr(x), x), B2)
+    y = _f2_sqrt(y2)
+    if y is None:
+        raise ValueError("G2 x not on curve")
+    larger = (y[1], y[0]) > ((P - y[1]) % P, (P - y[0]) % P)
+    if (flag == _COMPRESSED_LARGEST) != larger:
+        y = f2_neg(y)
+    q = (x, y)
+    if _g2_mul(R, q) is not None:
+        raise ValueError("G2 point not in r-torsion subgroup")
+    return q
+
+
 def g2_unmarshal(b: bytes):
+    if len(b) == SIGNATURE_SIZE_COMPRESSED:
+        return g2_decompress(b)
     if len(b) != 128:
         raise ValueError("bad G2 length")
     if b == b"\x00" * 128:
@@ -747,6 +798,15 @@ def aggregate_signatures(sigs) -> bytes:
     return g2_marshal(total)
 
 
+def aggregate_signatures_compressed(sigs) -> bytes:
+    """Same G2 sum, emitted in the 64-byte compressed wire form the block
+    commit carries under CMTPU_AGG_COMMITS."""
+    total = None
+    for s in sigs:
+        total = _g2_add(total, g2_unmarshal(bytes(s)))
+    return g2_compress(total)
+
+
 def verify_aggregate(pub_keys, msgs, agg_sig: bytes) -> bool:
     """e(G1, agg) == prod_i e(pk_i, H(m_i)) as n+1 Miller loops sharing one
     final exponentiation. pub_keys are compressed G1 bytes, msgs the
@@ -786,6 +846,47 @@ def verify_aggregate_slow(pub_keys, msgs, agg_sig: bytes) -> bool:
             pairs.append(((pk[0], (P - pk[1]) % P), _hash_to_g2(m)))
         pairs.append((G1, s))
         return pairing_check(pairs)
+    except (ValueError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Proof of possession (round 10). Plain BLS aggregation is vulnerable to the
+# rogue-key attack: a registrant who publishes pk' = pk_rogue − Σ pk_honest
+# can forge an aggregate "signed" by the whole set. The standard defence
+# (Ristenpart–Yilek; draft-irtf-cfrg-bls-signature §3.3) is to demand, at
+# KEY REGISTRATION time, a signature over the key's own serialization under
+# a domain-separation tag no consensus message can collide with — consensus
+# sign-bytes are length-prefixed protobuf of SignedMsgType ≥ 1, so this
+# ASCII prefix is unreachable from any vote or proposal.
+
+POP_DST = b"CMTPU-BN254-POP-V1|"
+
+
+def pop_sign_bytes(pub_key_bytes: bytes) -> bytes:
+    return POP_DST + bytes(pub_key_bytes)
+
+
+def prove_possession(priv: "PrivKey") -> bytes:
+    """64-byte compressed G2 proof that the holder knows the secret scalar
+    behind their published pubkey — required in genesis for bn254 keys."""
+    sig = priv.sign(pop_sign_bytes(priv.pub_key().bytes()))
+    return g2_compress(g2_unmarshal(sig))
+
+
+def verify_possession(pub_key_bytes: bytes, pop: bytes) -> bool:
+    """One fast pairing check; accepts either G2 wire form. Never raises —
+    malformed input is simply an invalid proof."""
+    if len(pop) not in (SIGNATURE_SIZE, SIGNATURE_SIZE_COMPRESSED):
+        return False
+    try:
+        pk = g1_decompress(bytes(pub_key_bytes))
+        s = g2_unmarshal(bytes(pop))
+        if pk is None or s is None:
+            return False
+        hm = _hash_to_g2_cached(pop_sign_bytes(pub_key_bytes))
+        neg_pk = (pk[0], (P - pk[1]) % P)
+        return pairing_check_fast([(neg_pk, hm), (G1, s)])
     except (ValueError, TypeError):
         return False
 

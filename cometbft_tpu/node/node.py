@@ -411,6 +411,52 @@ class Node:
                        "95th-percentile coalescer queue wait, microseconds.",
                        sched_sample("queue_wait_p95_us"))
 
+        def sidecar_sample(key):
+            # Lazy like the others: zeros until a grpc tier exists (bare
+            # CMTPU_BACKEND=grpc client, or the auto chain's sidecar tier,
+            # possibly chaos-wrapped). Never dials or constructs.
+            def fn():
+                b = backend_mod._backend
+                if getattr(b, "name", "") == "coalesce":
+                    b = b.inner
+                g = None
+                if getattr(b, "name", "") == "grpc":
+                    g = b
+                else:
+                    for t in getattr(b, "tiers", []):
+                        be = t.backend
+                        if getattr(be, "name", "").startswith("chaos"):
+                            be = be.inner
+                        if getattr(be, "name", "") == "grpc":
+                            g = be
+                            break
+                counters = getattr(g, "counters", None)
+                if counters is None:
+                    return 0
+                return counters().get(key, 0)
+
+            return fn
+
+        reg.gauge_func("sidecar", "streamed_calls",
+                       "Batch verifications streamed to the sidecar in "
+                       "chunks.",
+                       sidecar_sample("streamed_calls"))
+        reg.gauge_func("sidecar", "streamed_chunks",
+                       "Chunks sent on streamed sidecar verifications.",
+                       sidecar_sample("streamed_chunks"))
+        reg.gauge_func("sidecar", "unary_calls",
+                       "Batch verifications sent to the sidecar as one "
+                       "frame.",
+                       sidecar_sample("unary_calls"))
+        reg.gauge_func("sidecar", "stream_retries",
+                       "Streamed sidecar calls retried on a fresh "
+                       "connection.",
+                       sidecar_sample("stream_retries"))
+        reg.gauge_func("sidecar", "remote_mesh_width",
+                       "Serving pod chip count from the Ping capability "
+                       "reply.",
+                       sidecar_sample("remote_mesh_width"))
+
     @staticmethod
     def _register_mesh_metrics(reg) -> None:
         """mesh_* gauges: pod-scale sharding of the device verify tier

@@ -501,7 +501,10 @@ class Bn254DeviceBackend:
     def aggregate_verify(self, pubs, msgs, agg_sig) -> bool:
         if len(pubs) != len(msgs) or not pubs:
             return False
-        if len(agg_sig) != _b.SIGNATURE_SIZE:
+        if len(agg_sig) not in (
+            _b.SIGNATURE_SIZE,
+            _b.SIGNATURE_SIZE_COMPRESSED,
+        ):
             return False
         try:
             s = _b.g2_unmarshal(bytes(agg_sig))

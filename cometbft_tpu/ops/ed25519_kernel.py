@@ -120,6 +120,26 @@ def bucket_for(n: int) -> int:
     return b
 
 
+def preferred_stream_chunk() -> int:
+    """Chunk size the sidecar advertises to streaming clients (Ping
+    capability field 4): the smallest compiled batch bucket that is both
+    commit-sized and a mesh-width multiple, so every streamed chunk lands
+    on the bucket ladder with zero padding and — at/above mesh_floor() —
+    routes through the sharded program like the in-process tier. Uses the
+    passively-known width only: a sidecar that has never dispatched yet
+    must not probe a possibly-wedged tunnel from a Ping."""
+    w = known_mesh_width() or 1
+    target = max(1024, 128 * w)
+    for b in BUCKETS:
+        if target <= b:
+            break
+    else:
+        b = int(2 ** np.ceil(np.log2(target)))
+    if w > 1 and b % w:
+        b += w - b % w
+    return b
+
+
 _mesh_lock = threading.Lock()
 _mesh_counters = {
     "sharded_dispatches": 0,  # verify dispatches routed to the mesh program

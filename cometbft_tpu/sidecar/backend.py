@@ -401,6 +401,35 @@ class HybridBackend(VerifyBackend):
         )
 
 
+class LockedBackend(VerifyBackend):
+    """Serializes every device call of a wrapped backend behind one lock.
+
+    The sidecar server wires this under its CoalescingScheduler: the
+    scheduler's single dispatcher merges requests from many CONNECTIONS
+    into one columnar dispatch, and this wrapper keeps the historic
+    one-TPU/one-XLA-stream discipline (the axon tunnel wedges under
+    concurrent clients) for the calls that bypass the scheduler — merkle
+    roots, warmup — without the handler threads holding the lock across a
+    whole verification."""
+
+    def __init__(self, inner: VerifyBackend, lock: threading.Lock):
+        self.inner = inner
+        self.name = getattr(inner, "name", "device")
+        self._device_lock = lock
+
+    def batch_verify(self, pubs, msgs, sigs):
+        with self._device_lock:
+            return self.inner.batch_verify(pubs, msgs, sigs)
+
+    def merkle_root(self, leaves):
+        with self._device_lock:
+            return self.inner.merkle_root(leaves)
+
+    def mesh_width(self) -> int:
+        mw = getattr(self.inner, "mesh_width", None)
+        return int(mw()) if mw is not None else 1
+
+
 _backend: VerifyBackend | None = None
 _lock = threading.Lock()
 

@@ -35,6 +35,12 @@ chain (backend.py wires it above `build_resilient()`'s supervisor):
 Single requests larger than `CMTPU_COALESCE_MAX` are never split — the
 hybrid planner owns WITHIN-call splitting; this layer only merges ACROSS
 callers, and the supervisor between them bounds whatever is dispatched.
+
+The sidecar SERVER embeds the same scheduler over its device lock
+(sidecar/service.py, round 10): there the concurrent submitters are
+CONNECTIONS — many node processes sharing one tunnel — and streamed
+chunks, so cross-process requests merge into one columnar dispatch with
+the identical slicing/fallback discipline.
 """
 
 from __future__ import annotations
@@ -382,6 +388,7 @@ class CoalescingScheduler(VerifyBackend):
     def counters(self) -> dict:
         with self._cond:
             out = dict(self.counters_)
+            out["queue_depth"] = len(self._queue)
         out["max_sigs"] = self.max_sigs
         d = max(1, out["dispatches"])
         out["coalesce_ratio"] = round(out["requests"] / d, 3)

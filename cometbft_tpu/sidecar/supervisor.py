@@ -466,15 +466,24 @@ class ResilientBackend(VerifyBackend):
             out = dict(self.counters_)
         out["active_tier"] = self.active_tier
         out["chain"] = [t.name for t in self.tiers]
-        out["tiers"] = {
-            t.name: {
+        out["tiers"] = {}
+        for t in self.tiers:
+            entry = {
                 "state": t.state,
                 "calls": t.calls,
                 "failures": t.failures,
                 "trips": t.trips,
             }
-            for t in self.tiers
-        }
+            # Tier backends with their own counters (the grpc client's
+            # streamed/unary split, a chaos wrapper's injections) surface
+            # them here so one snapshot explains the whole chain.
+            tc = getattr(t.backend, "counters", None)
+            if tc is not None:
+                try:
+                    entry["backend"] = tc()
+                except Exception:
+                    pass
+            out["tiers"][t.name] = entry
         return out
 
     def register_metrics(self, registry) -> None:

@@ -323,8 +323,11 @@ class CommitSig:
 # this rebuild's bn254 signatures are UNCOMPRESSED G2 (crypto/bn254.py
 # SIGNATURE_SIZE = 128), so per-vote bn254 commits need the extra room.
 MAX_SIGNATURE_SIZE = 128
-# Aggregate-commit wire form (ISSUE 9): one uncompressed bn254 G2 sum.
+# Aggregate-commit wire form (ISSUE 9): one bn254 G2 sum. Round 10 shrinks
+# new blocks to the 64-byte compressed encoding; the uncompressed 128-byte
+# form stays accepted so blocks produced by earlier rounds keep validating.
 AGG_SIGNATURE_SIZE = 128
+AGG_SIGNATURE_SIZE_COMPRESSED = 64
 
 
 @dataclass
@@ -550,7 +553,8 @@ class Commit:
     def validate_basic(self) -> None:
         """types/block.go:860-893, plus the aggregate-form consistency rules:
         the bitmap must mirror the non-absent entries exactly, every per-sig
-        column must be empty, and the G2 point is a fixed 128 bytes."""
+        column must be empty, and the G2 point is 64 (compressed) or 128
+        (uncompressed) bytes."""
         if self.height < 0:
             raise ValueError("negative Height")
         if self.round < 0:
@@ -564,9 +568,13 @@ class Commit:
                 raise ValueError("no signatures in commit")
             aggregated = self.is_aggregate()
             if aggregated:
-                if len(self.agg_signature) != AGG_SIGNATURE_SIZE:
+                if len(self.agg_signature) not in (
+                    AGG_SIGNATURE_SIZE,
+                    AGG_SIGNATURE_SIZE_COMPRESSED,
+                ):
                     raise ValueError(
-                        "aggregate signature must be 128 bytes (bn254 G2)"
+                        "aggregate signature must be 64 (compressed) or "
+                        "128 bytes (bn254 G2)"
                     )
                 n = len(self.signatures)
                 if len(self.agg_bitmap) != (n + 7) // 8:
@@ -616,7 +624,7 @@ def aggregate_commit(commit: "Commit", vals) -> "Commit":
     if not raw:
         return commit
     try:
-        agg = bn254.aggregate_signatures(raw)
+        agg = bn254.aggregate_signatures_compressed(raw)
     except (ValueError, TypeError):
         # An admitted vote with an unparseable signature would be a bug
         # upstream; never let it block block production — ship per-vote.

@@ -31,9 +31,14 @@ class GenesisValidator:
     pub_key: object
     power: int
     name: str = ""
+    # BLS proof of possession (round 10): required for bn254 keys — plain
+    # BLS aggregation without one is open to the rogue-key attack, so
+    # validate_and_complete rejects a bn254 validator whose proof is
+    # missing or invalid. Empty for non-aggregating key types.
+    pop: bytes = b""
 
     def to_json(self) -> dict:
-        return {
+        d = {
             "address": self.address.hex().upper(),
             "pub_key": {
                 "type": _KEY_TYPE_TO_JSON_NAME[self.pub_key.type()],
@@ -42,6 +47,9 @@ class GenesisValidator:
             "power": str(self.power),
             "name": self.name,
         }
+        if self.pop:
+            d["proof_of_possession"] = base64.b64encode(self.pop).decode()
+        return d
 
     @classmethod
     def from_json(cls, d: dict) -> "GenesisValidator":
@@ -56,6 +64,7 @@ class GenesisValidator:
             pub_key=pub_key,
             power=int(d["power"]),
             name=d.get("name", ""),
+            pop=base64.b64decode(d.get("proof_of_possession", "") or ""),
         )
 
 
@@ -94,6 +103,22 @@ class GenesisDoc:
                 raise ValueError(f"incorrect address for validator {i}")
             if not v.address:
                 v.address = v.pub_key.address()
+            if v.pub_key.type() == "bn254":
+                from cometbft_tpu.crypto import bn254
+
+                if not v.pop:
+                    raise ValueError(
+                        f"validator {i} ({v.name or v.address.hex()}): bn254 "
+                        "keys require a proof_of_possession in genesis — "
+                        "without one a registrant can mount the rogue-key "
+                        "attack against aggregate BLS commits"
+                    )
+                if not bn254.verify_possession(v.pub_key.bytes(), v.pop):
+                    raise ValueError(
+                        f"validator {i} ({v.name or v.address.hex()}): "
+                        "invalid bn254 proof_of_possession — rejecting "
+                        "possible rogue key"
+                    )
         if self.genesis_time.is_zero():
             self.genesis_time = cmttime.now()
 

@@ -87,6 +87,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "sidecar: verification-sidecar tests (framed protocol, chunked "
+        "streaming, frame-size guard, cross-connection coalescing, "
+        "mid-stream redial); runs in tier-1 — `-m sidecar` selects just "
+        "this group",
+    )
+    config.addinivalue_line(
+        "markers",
         "agg: aggregate BLS commit tests (BN254 aggregate wire form, "
         "three-mode verify bit-parity, poisoned-aggregate rejection, "
         "device multi-pairing kernel); fast paths run in tier-1, the "

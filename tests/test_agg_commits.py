@@ -17,6 +17,7 @@ from cometbft_tpu.sidecar.supervisor import ResilientBackend
 from cometbft_tpu.types import BlockID, Commit, Vote
 from cometbft_tpu.types.block import (
     AGG_SIGNATURE_SIZE,
+    AGG_SIGNATURE_SIZE_COMPRESSED,
     PRECOMMIT_TYPE,
     CommitSig,
     aggregate_commit,
@@ -99,7 +100,7 @@ def test_mixed_valset_falls_back_to_scalar(bn_set):
 def test_aggregate_form_and_wire_roundtrip(bn_set):
     _, vals, commit, agg = bn_set
     assert agg.is_aggregate()
-    assert len(agg.agg_signature) == AGG_SIGNATURE_SIZE
+    assert len(agg.agg_signature) == AGG_SIGNATURE_SIZE_COMPRESSED
     assert all(not cs.signature for cs in agg.signatures)
     assert all(agg.agg_signer(i) for i in range(len(vals.validators)))
     agg.validate_basic()
@@ -281,3 +282,206 @@ def test_device_backend_decision_parity(bn_set, monkeypatch):
     dev = bn254_kernel.Bn254DeviceBackend()
     assert dev.aggregate_verify(pubs, msgs, agg) is True
     assert dev.aggregate_verify(pubs, list(reversed(msgs)), agg) is False
+
+
+# ---------------------------------------------------------------------------
+# Round 10: compressed G2 aggregate wire form.
+
+
+def test_g2_compression_roundtrip():
+    privs = [bn254.gen_priv_key() for _ in range(5)]
+    sigs = [p.sign(b"msg-%d" % i) for i, p in enumerate(privs)]
+    # Round-trip each individual signature AND the aggregate sum, hitting
+    # both flag values (sign of y varies per point).
+    points = [bn254.g2_unmarshal(s) for s in sigs]
+    points.append(bn254.g2_unmarshal(bn254.aggregate_signatures(sigs)))
+    for q in points:
+        comp = bn254.g2_compress(q)
+        assert len(comp) == bn254.SIGNATURE_SIZE_COMPRESSED
+        assert bn254.g2_decompress(comp) == q
+        # g2_unmarshal dispatches on length, so the compressed form flows
+        # through every verify path unchanged.
+        assert bn254.g2_unmarshal(comp) == q
+    # Infinity encodes to the flagged zero block and back.
+    inf = bn254.g2_compress(None)
+    assert inf[0] == 0b01 << 6 and not any(inf[1:])
+    assert bn254.g2_decompress(inf) is None
+
+
+def test_g2_compressed_and_uncompressed_verify_identically():
+    privs = [bn254.gen_priv_key() for _ in range(4)]
+    msgs = [b"m-%d" % i for i in range(4)]
+    pubs = [p.pub_key().bytes() for p in privs]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    full = bn254.aggregate_signatures(sigs)
+    comp = bn254.aggregate_signatures_compressed(sigs)
+    assert len(full) == 128 and len(comp) == 64
+    assert bn254.g2_unmarshal(comp) == bn254.g2_unmarshal(full)
+    assert bn254.verify_aggregate(pubs, msgs, comp) is True
+    assert bn254.verify_aggregate(pubs, msgs, full) is True
+    assert bn254.verify_aggregate_slow(pubs, msgs, comp) is True
+    # Wrong message set rejects in the compressed form too.
+    assert bn254.verify_aggregate(pubs, list(reversed(msgs)), comp) is False
+
+
+def test_g2_decompress_rejects_tampered_encodings():
+    priv = bn254.gen_priv_key()
+    comp = bytearray(bn254.g2_compress(bn254.g2_unmarshal(priv.sign(b"m"))))
+
+    # Flipped flag: same x, other y root -> still on-curve and in-subgroup,
+    # but it MUST decode to the negated point, not the original.
+    flipped = bytearray(comp)
+    flipped[0] ^= 0b01 << 6
+    q = bn254.g2_decompress(bytes(comp))
+    assert bn254.g2_decompress(bytes(flipped)) == (q[0], bn254.f2_neg(q[1]))
+
+    # Uncompressed-flag first byte (0b00) is not a valid compressed form.
+    bare = bytearray(comp)
+    bare[0] &= 0b0011_1111
+    with pytest.raises(ValueError):
+        bn254.g2_decompress(bytes(bare))
+
+    # Corrupt x: overwhelmingly lands off-curve (no Fp2 sqrt) or out of
+    # subgroup; either way it must raise, never return a wrong point.
+    bad_x = bytearray(comp)
+    bad_x[40] ^= 0xFF
+    with pytest.raises(ValueError):
+        bn254.g2_decompress(bytes(bad_x))
+
+    # Non-canonical infinity (flag set but trailing garbage).
+    bad_inf = bytearray(64)
+    bad_inf[0] = 0b01 << 6
+    bad_inf[63] = 1
+    with pytest.raises(ValueError):
+        bn254.g2_decompress(bytes(bad_inf))
+
+    # Wrong lengths.
+    for n in (0, 32, 63, 65, 127):
+        with pytest.raises(ValueError):
+            bn254.g2_decompress(b"\x00" * n)
+
+
+def test_uncompressed_aggregate_commit_still_validates(bn_set):
+    # Blocks produced before round 10 carry the 128-byte aggregate; they
+    # must keep decoding, validating, and verifying.
+    _, vals, commit, agg = bn_set
+    legacy = copy.deepcopy(agg)
+    legacy.agg_signature = bn254.g2_marshal(
+        bn254.g2_unmarshal(agg.agg_signature)
+    )
+    assert len(legacy.agg_signature) == AGG_SIGNATURE_SIZE
+    legacy.validate_basic()
+    dec = Commit.decode(legacy.encode())
+    assert dec == legacy
+    verify_commit(CHAIN, vals, BID, HEIGHT, legacy)
+
+
+# ---------------------------------------------------------------------------
+# Round 10: proof of possession at key registration.
+
+
+def test_proof_of_possession_roundtrip():
+    priv = bn254.gen_priv_key()
+    pop = bn254.prove_possession(priv)
+    assert len(pop) == bn254.SIGNATURE_SIZE_COMPRESSED
+    assert bn254.verify_possession(priv.pub_key().bytes(), pop) is True
+    # A proof is bound to ITS key: another key cannot reuse it, and junk
+    # never verifies (and never raises).
+    other = bn254.gen_priv_key()
+    assert bn254.verify_possession(other.pub_key().bytes(), pop) is False
+    assert bn254.verify_possession(priv.pub_key().bytes(), b"\x00" * 64) is False
+    assert bn254.verify_possession(priv.pub_key().bytes(), b"junk") is False
+    # The PoP domain tag means a consensus signature over the pubkey bytes
+    # is NOT a valid proof — registration and voting never cross.
+    vote_style = priv.sign(priv.pub_key().bytes())
+    assert bn254.verify_possession(priv.pub_key().bytes(), vote_style) is False
+
+
+def test_rogue_key_cannot_prove_possession():
+    # The attack PoP exists to stop: publish pk' = [t]G1 - pk_honest so the
+    # "aggregate" of {pk_honest, pk'} collapses to [t]G1, which the attacker
+    # can sign for alone. The attacker KNOWS t but not the discrete log of
+    # pk', so no valid proof for pk' can be produced from t.
+    honest = bn254.gen_priv_key()
+    t = 123456789
+    pk_h = bn254.g1_decompress(honest.pub_key().bytes())
+    rogue_pt = bn254._g1_add(
+        bn254._g1_mul(t, bn254.G1), (pk_h[0], (bn254.P - pk_h[1]) % bn254.P)
+    )
+    rogue_pub = bn254.g1_compress(rogue_pt)
+    # Best effort with what the attacker knows: sign the PoP message with t.
+    forged = bn254.PrivKey(t.to_bytes(32, "big")).sign(
+        bn254.pop_sign_bytes(rogue_pub)
+    )
+    assert bn254.verify_possession(rogue_pub, forged) is False
+
+
+def _genesis_with(validators):
+    from cometbft_tpu.types.cmttime import Time
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    return GenesisDoc(
+        chain_id="pop-chain",
+        genesis_time=Time(1700000000, 0),
+        validators=validators,
+    )
+
+
+def test_genesis_enforces_bn254_pop():
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    priv = bn254.gen_priv_key()
+    pub = priv.pub_key()
+
+    missing = _genesis_with([GenesisValidator(pub.address(), pub, 10, "v0")])
+    with pytest.raises(ValueError, match="proof_of_possession"):
+        missing.validate_and_complete()
+
+    wrong = _genesis_with(
+        [
+            GenesisValidator(
+                pub.address(), pub, 10, "v0",
+                pop=bn254.prove_possession(bn254.gen_priv_key()),
+            )
+        ]
+    )
+    with pytest.raises(ValueError, match="rogue"):
+        wrong.validate_and_complete()
+
+    good = _genesis_with(
+        [
+            GenesisValidator(
+                pub.address(), pub, 10, "v0", pop=bn254.prove_possession(priv)
+            )
+        ]
+    )
+    good.validate_and_complete()
+    # The proof survives the genesis.json round trip and re-validates
+    # (from_json runs validate_and_complete itself).
+    doc2 = GenesisDoc.from_json(good.to_json())
+    assert doc2.validators[0].pop == good.validators[0].pop
+
+    # Non-aggregating key types need no proof, and their JSON carries none.
+    ed_pv = MockPV(ed25519.gen_priv_key())
+    ed_doc = _genesis_with(
+        [GenesisValidator(ed_pv.address(), ed_pv.get_pub_key(), 10, "e0")]
+    )
+    ed_doc.validate_and_complete()
+    assert "proof_of_possession" not in ed_doc.validators[0].to_json()
+
+
+def test_testnet_cli_emits_pops_for_bn254(tmp_path):
+    from cometbft_tpu.cmd.__main__ import main as cli
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli([
+        "testnet", "--validators", "2", "--non-validators", "0",
+        "--key-types", "bn254,ed25519",
+        "--output-dir", out, "--chain-id", "pop-net",
+    ]) == 0
+    doc = GenesisDoc.from_file(
+        os.path.join(out, "node0", "config", "genesis.json")
+    )
+    by_type = {v.pub_key.type(): v for v in doc.validators}
+    assert by_type["bn254"].pop and not by_type["ed25519"].pop

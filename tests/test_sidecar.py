@@ -23,6 +23,8 @@ from cometbft_tpu.types.vote import Vote, vote_to_commit_sig
 
 CHAIN_ID = "sidecar-chain"
 
+pytestmark = pytest.mark.sidecar
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -394,3 +396,281 @@ def test_coalescer_pinned_cap_never_moves():
     sched = CoalescingScheduler(_WidthStubBackend(8), max_sigs=99)
     assert sched.refresh_cap() == 99 and sched.max_sigs == 99
     sched.close()
+
+
+# -- round 10: frame guard + chunked streaming -------------------------------
+
+
+def _signed_triples(n, tag=b"stream", corrupt=()):
+    pv = ed25519.gen_priv_key_from_secret(tag)
+    pub = pv.pub_key().bytes()
+    msgs = [b"%s-%d" % (tag, i) for i in range(n)]
+    sigs = [pv.sign(m) for m in msgs]
+    for i in corrupt:
+        sigs[i] = sigs[i][:-1] + bytes([sigs[i][-1] ^ 1])
+    return [pub] * n, msgs, sigs
+
+
+def test_write_frame_refuses_oversized(monkeypatch):
+    from cometbft_tpu.sidecar.service import FrameTooLarge, write_frame
+
+    monkeypatch.setenv("CMTPU_SIDECAR_MAX_FRAME", "2048")
+
+    class _NeverSock:
+        def sendall(self, data):  # pragma: no cover - guard must fire first
+            raise AssertionError("oversized frame reached the socket")
+
+    with pytest.raises(FrameTooLarge, match="refusing to send"):
+        write_frame(_NeverSock(), b"\x00" * 4096)
+
+
+def test_oversized_frame_error_response_connection_survives(monkeypatch):
+    """Satellite: an over-cap frame draws a loud error response instead of
+    an unbounded allocation, and the SAME connection keeps serving."""
+    import struct as _struct
+
+    from cometbft_tpu.sidecar import service
+    from cometbft_tpu.wire import proto
+
+    monkeypatch.setenv("CMTPU_SIDECAR_MAX_FRAME", "2048")
+    addr = f"127.0.0.1:{_free_port()}"
+    server = SidecarServer(addr, backend=CpuBackend()).start()
+    host, port = addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        sock.sendall(_struct.pack(">I", 4096) + b"\x00" * 4096)
+        resp = service.read_frame(sock)
+        fields = proto.decode_fields(resp)
+        assert not proto.get_bool(fields, 2)
+        assert "FrameTooLarge" in proto.get_string(fields, 3)
+        # The connection survives: a well-formed Ping still answers.
+        req = service._encode_request(7, "Ping", b"")
+        sock.sendall(_struct.pack(">I", len(req)) + req)
+        fields = proto.decode_fields(service.read_frame(sock))
+        assert proto.get_uvarint(fields, 1) == 7
+        assert proto.get_bool(fields, 2)
+    finally:
+        sock.close()
+        server.shutdown()
+
+
+def test_ping_advertises_streaming_capability(sidecar):
+    client, _ = sidecar
+    assert client._remote_streams is None  # unprobed
+    assert client.ping()
+    assert client._remote_streams is True
+    assert client._remote_chunk >= 1
+    assert client.counters()["streaming"] is True
+
+
+def test_chunk_size_aligns_to_remote_width(monkeypatch):
+    client = GrpcBackend("127.0.0.1:1", timeout_s=1)
+    client._remote_mesh_width = 8
+    monkeypatch.setenv("CMTPU_SIDECAR_CHUNK", "10")
+    assert client.chunk_size() == 16  # rounded UP to a width multiple
+    monkeypatch.delenv("CMTPU_SIDECAR_CHUNK")
+    client._remote_chunk = 20
+    assert client.chunk_size() == 24
+
+
+def test_streamed_batch_verify_bit_identical(monkeypatch, sidecar):
+    """The tentpole contract: a streamed call returns the exact bitmap the
+    in-process backend computes, corrupted lanes localized across chunk
+    boundaries, and actually went over the wire in chunks."""
+    client, _ = sidecar
+    monkeypatch.setenv("CMTPU_SIDECAR_CHUNK", "8")
+    corrupt = (3, 8, 30)  # first chunk, a chunk boundary, a later chunk
+    pubs, msgs, sigs = _signed_triples(37, corrupt=corrupt)
+    ok, bitmap = client.batch_verify(pubs, msgs, sigs)
+    ref_ok, ref_bits = CpuBackend().batch_verify(pubs, msgs, sigs)
+    assert (ok, bitmap) == (ref_ok, ref_bits)
+    assert not ok and [i for i, b in enumerate(bitmap) if not b] == list(corrupt)
+    c = client.counters()
+    assert c["streamed_calls"] == 1
+    assert c["streamed_chunks"] == 5  # ceil(37 / 8)
+    assert c["unary_calls"] == 0
+    # All-good batch too (ok path), reusing the learned capability.
+    pubs, msgs, sigs = _signed_triples(17, tag=b"stream2")
+    ok, bitmap = client.batch_verify(pubs, msgs, sigs)
+    assert ok and bitmap == [True] * 17
+
+
+def test_small_batches_stay_unary(monkeypatch, sidecar):
+    client, _ = sidecar
+    monkeypatch.setenv("CMTPU_SIDECAR_CHUNK", "64")
+    pubs, msgs, sigs = _signed_triples(8, tag=b"unary")
+    ok, bitmap = client.batch_verify(pubs, msgs, sigs)
+    assert ok and bitmap == [True] * 8
+    c = client.counters()
+    assert c["unary_calls"] == 1 and c["streamed_calls"] == 0
+
+
+def test_legacy_unary_client_against_new_server(sidecar):
+    """A round-9 client knows nothing of BatchVerifyChunk: its unary
+    BatchVerify (now routed through the server-side scheduler) must still
+    verify correctly against the upgraded server."""
+    client, _ = sidecar
+    pubs, msgs, sigs = _signed_triples(24, tag=b"legacy", corrupt=(5,))
+    # The legacy wire call, byte-for-byte: one framed BatchVerify request.
+    from cometbft_tpu.wire import proto
+
+    payload = b"".join(
+        proto.field_bytes(1, p, emit_default=True) for p in pubs
+    ) + b"".join(
+        proto.field_bytes(2, m, emit_default=True) for m in msgs
+    ) + b"".join(
+        proto.field_bytes(3, s, emit_default=True) for s in sigs
+    )
+    out = client._call("BatchVerify", payload)
+    fields = proto.decode_fields(out)
+    bitmap = [bool(b) for b in proto.get_bytes(fields, 2)]
+    assert not proto.get_bool(fields, 1)
+    assert bitmap == [i != 5 for i in range(24)]
+
+
+def test_server_coalesces_across_connections(monkeypatch):
+    """Tentpole part 3: concurrent CONNECTIONS merge into one device
+    dispatch via the server-side scheduler, bitmaps sliced per request."""
+    monkeypatch.setenv("CMTPU_COALESCE_WINDOW_MS", "75")
+    addr = f"127.0.0.1:{_free_port()}"
+    server = SidecarServer(addr, backend=CpuBackend()).start()
+    clients = [GrpcBackend(addr, timeout_s=10) for _ in range(3)]
+    try:
+        pubs, msgs, sigs = _signed_triples(6, tag=b"merge", corrupt=(2,))
+        results, errors = [], []
+
+        def worker(cl):
+            try:
+                results.append(cl.batch_verify(pubs, msgs, sigs))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(c,)) for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        expected = [i != 2 for i in range(6)]
+        assert results == [(False, expected)] * 3
+        c = server.scheduler_counters()
+        assert c["requests"] == 3
+        assert c["coalesced_dispatches"] >= 1
+        assert c["batched_requests"] >= 2
+        # Identical triples from different connections share lanes.
+        assert c["dedup_sigs"] >= 6
+    finally:
+        for cl in clients:
+            cl.close()
+        server.shutdown()
+
+
+class _KillMidStreamServer:
+    """Speaks the framed protocol far enough to advertise streaming, then
+    drops the connection AND the listener on the first chunk — the sidecar
+    process dying mid-streamed-dispatch."""
+
+    def __init__(self):
+        self._lsock = socket.socket()
+        # Accepted conns inherit SO_REUSEADDR; without it the killer's side
+        # of the dropped stream sits in TIME_WAIT owning the port and the
+        # replacement SidecarServer cannot bind it back.
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(4)
+        self.addr = "127.0.0.1:%d" % self._lsock.getsockname()[1]
+        self.port = self._lsock.getsockname()[1]
+        self.chunk_seen = threading.Event()
+        self.closed = threading.Event()  # listener really released the port
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        from cometbft_tpu.sidecar import service
+        from cometbft_tpu.wire import proto
+
+        try:
+            conn, _ = self._lsock.accept()
+        except OSError:
+            return
+        while True:
+            try:
+                body = service.read_frame(conn)
+            except (OSError, ValueError):
+                body = None
+            if body is None:
+                break
+            fields = proto.decode_fields(body)
+            req_id = proto.get_uvarint(fields, 1)
+            method = proto.get_string(fields, 2)
+            if method == "Ping":
+                reply = (
+                    proto.field_bytes(1, b"pong")
+                    + proto.field_varint(2, 1)
+                    + proto.field_varint(3, 1)
+                    + proto.field_varint(4, 4)
+                )
+                service.write_frame(
+                    conn, service._encode_response(req_id, True, "", reply)
+                )
+                continue
+            # First streamed chunk: die mid-stream.
+            self.chunk_seen.set()
+            break
+        conn.close()
+        self._lsock.close()
+        self.closed.set()
+
+    def shutdown(self):
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.closed.set()
+
+
+def test_redial_during_inflight_stream_degrades_then_recovers():
+    """Satellite: kill the server mid-stream. The supervisor must degrade
+    the call (bounded, full correct bitmap — never a partial one), and
+    once a live server is back on the same port the next call reconnects
+    and streams again."""
+    from cometbft_tpu.sidecar.supervisor import ResilientBackend
+
+    killer = _KillMidStreamServer()
+    client = GrpcBackend(killer.addr, timeout_s=5, connect_timeout_s=0.5)
+    sup = ResilientBackend(
+        [("grpc", client), ("cpu", CpuBackend())],
+        deadline_ms=0, retries=0, backoff_ms=1,
+        breaker_threshold=3, breaker_cooldown_ms=100, crosscheck="off",
+    )
+    try:
+        pubs, msgs, sigs = _signed_triples(20, tag=b"killed", corrupt=(7, 13))
+        expected = [i not in (7, 13) for i in range(20)]
+        assert client.ping()  # learn streaming capability + chunk 4
+        t0 = time.perf_counter()
+        ok, bits = sup.batch_verify(pubs, msgs, sigs)
+        elapsed = time.perf_counter() - t0
+        assert killer.chunk_seen.is_set(), "stream never reached the server"
+        assert (ok, bits) == (False, expected)  # anchor answered, in full
+        assert elapsed < 10, f"degradation took {elapsed:.1f}s"
+        assert sup.counters()["degraded_calls"] >= 1
+        # Server returns on the SAME port; past the breaker cooldown the
+        # next call re-dials and streams end to end.
+        assert killer.closed.wait(5), "killer never released the port"
+        server = SidecarServer(f"127.0.0.1:{killer.port}", backend=CpuBackend()).start()
+        try:
+            deadline = time.monotonic() + 5
+            while True:
+                time.sleep(0.15)  # breaker cooldown + redial backoff
+                ok, bits = sup.batch_verify(pubs, msgs, sigs)
+                assert (ok, bits) == (False, expected)
+                if client.counters()["streamed_calls"] >= 1:
+                    break
+                assert time.monotonic() < deadline, (
+                    f"never streamed again: {client.counters()}"
+                )
+        finally:
+            server.shutdown()
+    finally:
+        sup.close()
+        killer.shutdown()

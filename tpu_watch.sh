@@ -62,7 +62,7 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath + lightgw + mesh stages ride in the
+      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar stages ride in the
       # carried JSON (host-side scheduler/admission/vote-batching/gateway
       # speedups measured while the device was serving); surface them in
       # the history. None gates alt-mode adoption below. Helper python is
@@ -99,6 +99,12 @@ parts.append(
     f"wire {a['wire']['aggregate_vs_ed25519'] * 100:.2f}%"
     + (" verified" if a.get("device", {}).get("reject_ok") else "")
     if a else "agg absent")
+sc = rec.get("stages", {}).get("sidecar")
+parts.append(
+    f"sidecar {sc['speedup']}x stream {sc['n_chunks']}ch "
+    f"merge {sc['merge']['coalesce_ratio']}"
+    + (" bit-identical" if sc.get("bitmap_identical") else "")
+    if sc else "sidecar absent")
 print("; ".join(parts))
 PYEOF
       )
