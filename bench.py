@@ -1363,6 +1363,66 @@ def _hotpath_stage(stages: dict, plog) -> None:
     )
 
 
+def _simnet_stage(stages: dict, plog) -> None:
+    """Virtual-clock scenario throughput (ISSUE 13): VALS validators commit
+    BLOCKS blocks in-process on one SimClock with a seeded WAN latency
+    matrix.  Three arms on the same seed — baseline, vote-admission window
+    armed (the sim analog of CMTPU_VOTE_BATCH_WINDOW_MS), and tx load
+    injected — reporting blocks per simulated second, the sim-time /
+    wall-time acceleration, and the block-rate deltas across arms.  Knobs:
+    CMTPU_BENCH_SIMNET_VALS (100), CMTPU_BENCH_SIMNET_BLOCKS (20),
+    CMTPU_BENCH_SIMNET_WINDOW_MS (50)."""
+    from cometbft_tpu.simnet.scenario import run_scenario
+
+    vals = int(os.environ.get("CMTPU_BENCH_SIMNET_VALS", "") or 100)
+    blocks = int(os.environ.get("CMTPU_BENCH_SIMNET_BLOCKS", "") or 20)
+    window = float(os.environ.get("CMTPU_BENCH_SIMNET_WINDOW_MS", "") or 50.0)
+    base = dict(
+        validators=vals, blocks=blocks, seed=1234,
+        max_sim_s=40.0 * blocks + 120.0,
+    )
+
+    def _arm(name: str, **kw) -> dict:
+        rep = run_scenario(**{**base, **kw})
+        committed = rep["height_node0"] - 1
+        rate = (
+            round(committed / rep["sim_time_s"], 4) if rep["sim_time_s"] else 0.0
+        )
+        out = {
+            "ok": rep["ok"],
+            "sim_blocks_per_s": rate,
+            "sim_time_s": rep["sim_time_s"],
+            "wall_time_s": rep["wall_time_s"],
+            "accel": rep["accel"],
+            "events": rep["events"],
+            "vote_dispatches": rep["counters"]["vote_dispatches"],
+        }
+        plog(
+            f"simnet[{name}]: {committed} blocks, {rate} blocks/sim-s, "
+            f"{rep['accel']}x accel ({rep['wall_time_s']:.1f}s wall)"
+        )
+        return out
+
+    arms = {
+        "base": _arm("base"),
+        "vote_window": _arm("vote_window", vote_window_ms=window),
+        "tx_load": _arm("tx_load", tx_interval_s=1.0, txs_per_interval=8),
+    }
+    b = arms["base"]["sim_blocks_per_s"] or 1.0
+    stages["simnet"] = {
+        "validators": vals,
+        "blocks": blocks,
+        "vote_window_ms": window,
+        **{f"{k}_{m}": v for k, a in arms.items() for m, v in a.items()},
+        "block_rate_vote_window_ratio": round(
+            arms["vote_window"]["sim_blocks_per_s"] / b, 3
+        ),
+        "block_rate_tx_load_ratio": round(
+            arms["tx_load"]["sim_blocks_per_s"] / b, 3
+        ),
+    }
+
+
 def _lightgw_stage(stages: dict, plog) -> None:
     """Light-client gateway (ISSUE 7): N concurrent light clients sync the
     same span, independent bisections vs one shared gateway.
@@ -2136,6 +2196,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _lightgw_stage(stages, plog)
         except Exception as e:
             plog(f"lightgw stage failed: {type(e).__name__}: {e}")
+
+    # ---- simnet: virtual-clock 100-node scenario, sim vs wall time ----
+    if budget_left():
+        try:
+            _simnet_stage(stages, plog)
+        except Exception as e:
+            plog(f"simnet stage failed: {type(e).__name__}: {e}")
 
     # ---- aggregate BLS commits: scalar / host / device multi-pairing ----
     if budget_left():

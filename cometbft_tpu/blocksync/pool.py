@@ -27,14 +27,17 @@ class _Requester:
 class BlockPool:
     """blocksync/pool.go BlockPool."""
 
-    def __init__(self, start_height: int, send_request):
+    def __init__(self, start_height: int, send_request, clock=None):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
         self.height = start_height  # next height to sync
         self._send_request = send_request  # fn(peer_id, height)
+        self.clock = clock or MonotonicClock()
         self._mtx = threading.RLock()
         self._requesters: dict[int, _Requester] = {}
         self._peers: dict[str, int] = {}  # peer_id -> reported height
         self.max_peer_height = 0
-        self._last_advance = time.monotonic()
+        self._last_advance = self.clock.now()
 
     # -- peers ----------------------------------------------------------------
 
@@ -60,7 +63,7 @@ class BlockPool:
                     if len(self._requesters) >= MAX_PENDING_REQUESTS:
                         break
                     self._requesters[h] = _Requester(h)
-            now = time.monotonic()
+            now = self.clock.now()
             for req in self._requesters.values():
                 if req.block is not None:
                     continue
@@ -121,7 +124,7 @@ class BlockPool:
         with self._mtx:
             self._requesters.pop(self.height, None)
             self.height += 1
-            self._last_advance = time.monotonic()
+            self._last_advance = self.clock.now()
 
     def redo_request(self, height: int) -> str | None:
         """Invalid block: drop both pending blocks, re-request (reactor.go:375)."""
@@ -145,4 +148,4 @@ class BlockPool:
 
     def stalled_for(self) -> float:
         with self._mtx:
-            return time.monotonic() - self._last_advance
+            return self.clock.now() - self._last_advance

@@ -67,14 +67,22 @@ def decode_message(data: bytes):
 class BlocksyncReactor(Reactor):
     """blocksync/reactor.go Reactor."""
 
-    def __init__(self, state, block_exec, block_store, block_sync: bool, on_caught_up=None):
+    def __init__(
+        self, state, block_exec, block_store, block_sync: bool,
+        on_caught_up=None, clock=None,
+    ):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
         super().__init__("BLOCKSYNC")
+        self.clock = clock or MonotonicClock()
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
         self.block_sync_enabled = block_sync
         self.on_caught_up = on_caught_up  # fn(state) -> switch to consensus
-        self.pool = BlockPool(state.last_block_height + 1, self._send_request)
+        self.pool = BlockPool(
+            state.last_block_height + 1, self._send_request, clock=self.clock
+        )
         self._running = False
         self.synced = False
         self._prefetched_to = 0  # height up to which the window was batched
@@ -164,7 +172,7 @@ class BlocksyncReactor(Reactor):
         status_tick = 0.0
         while self._running and not self.synced:
             self.pool.make_requests()
-            now = time.monotonic()
+            now = self.clock.now()
             if now - status_tick > 10:
                 status_tick = now
                 if self.switch:
@@ -181,7 +189,7 @@ class BlocksyncReactor(Reactor):
                 if self.on_caught_up:
                     self.on_caught_up(self.state)
                 return
-            time.sleep(0.01)
+            self.clock.sleep(0.01)
 
     # Prefetch window: how many consecutive fetched blocks to batch-verify
     # in ONE device dispatch. 32 blocks x 1k validators fills the 32768

@@ -734,14 +734,14 @@ def main(argv=None) -> int:
         "generate", help="emit a seeded randomized testnet manifest"
     )
     ep.add_argument("--seed", type=int, required=True)
-    ep.add_argument("--profile", default="full", choices=["full", "small"])
+    ep.add_argument("--profile", default="full", choices=["full", "small", "sim"])
     ep.add_argument("--out", default="", help="output path (default stdout)")
     ep = e2e_sub.add_parser(
         "matrix", help="generate + run a seed range, collect repro artifacts"
     )
     ep.add_argument("--seeds", required=True,
                     help="seed spec: N, 'A..B' (inclusive) or comma list")
-    ep.add_argument("--profile", default="small", choices=["full", "small"])
+    ep.add_argument("--profile", default="small", choices=["full", "small", "sim"])
     ep.add_argument("--output-dir", default="")
 
     args = p.parse_args(argv)

@@ -97,7 +97,11 @@ class ConsensusState:
         logger=None,
         name: str = "",
         metrics=None,
+        clock=None,
     ):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
+        self.clock = clock or MonotonicClock()
         self.config = config
         self.block_exec = block_exec
         self.block_store = block_store
@@ -105,7 +109,7 @@ class ConsensusState:
         self.evpool = evpool
         self.event_bus = event_bus
         self.wal = wal or _NilWAL()
-        self.ticker = ticker or TimeoutTicker()
+        self.ticker = ticker or TimeoutTicker(clock=self.clock)
         self.logger = logger
         self.name = name
         from cometbft_tpu.consensus.metrics import Metrics as _CsMetrics
@@ -134,7 +138,7 @@ class ConsensusState:
         # Stall watchdog: no round-step progress for stall_factor × the
         # current round's full timeout budget ⇒ re-announce + re-arm.
         self._on_stall = None  # reactor hook: fn() -> None
-        self._last_progress = time.monotonic()
+        self._last_progress = self.clock.now()
         self._stall_factor = getattr(config, "stall_watchdog_factor", 10.0)
         env_factor = os.environ.get("CMTPU_STALL_FACTOR")
         if env_factor:
@@ -190,7 +194,7 @@ class ConsensusState:
             # the timer the restored step actually needs.
             with self._mtx:
                 self._rearm_step_timeout()
-        self._last_progress = time.monotonic()
+        self._last_progress = self.clock.now()
         if self._stall_factor > 0:
             threading.Thread(
                 target=self._stall_watchdog_routine, daemon=True
@@ -650,7 +654,7 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
-        self._last_progress = time.monotonic()
+        self._last_progress = self.clock.now()
         with self._height_events:
             self._height_events.notify_all()
 
@@ -724,51 +728,61 @@ class ConsensusState:
         self.ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
 
     def _new_step(self) -> None:
-        self._last_progress = time.monotonic()
+        self._last_progress = self.clock.now()
         if self.event_bus:
             self.event_bus.publish_new_round_step(self.rs.round_state_event())
 
     # -- stall watchdog -------------------------------------------------------
 
+    # Wall-clock poll cadence of the watchdog thread. The check itself is
+    # clock-driven (_stall_check reads self.clock), so tests and the simnet
+    # scenario harness invoke it directly on a virtual clock with no sleeps.
+    _WATCHDOG_POLL_S = 0.05
+
     def _stall_watchdog_routine(self) -> None:
-        """If the round state makes no progress for _stall_factor × the
-        current round's full (escalated) timeout budget, assume our
-        announcements or timers were lost: re-broadcast our round step +
-        observed majorities through the reactor hook and re-arm the current
-        step's timeout. Every action is idempotent, so a false positive
-        costs a few duplicate messages, never safety."""
         while self._running:
-            time.sleep(0.05)
-            factor = self._stall_factor
-            if factor <= 0:
-                continue
-            rs = self.rs
-            # Waiting for transactions is idle by design, not a stall.
-            if not self.config.create_empty_blocks and rs.step == STEP_NEW_ROUND:
-                self._last_progress = time.monotonic()
-                continue
-            budget = self.config.round_timeout_budget(rs.round) * factor
-            idle = time.monotonic() - self._last_progress
-            if idle < budget:
-                continue
-            self._last_progress = time.monotonic()  # re-arm before acting
-            self.metrics.consensus_stalls_total.inc()
-            self._log(
-                f"stall watchdog: no progress for {idle:.1f}s at "
-                f"{rs.height}/{rs.round}/{cstypes.STEP_NAMES.get(rs.step, rs.step)}"
-                "; re-announcing round state"
-            )
-            cb = self._on_stall
-            if cb is not None:
-                try:
-                    cb()
-                except Exception:
-                    pass
+            self.clock.sleep(self._WATCHDOG_POLL_S)
+            self._stall_check()
+
+    def _stall_check(self) -> bool:
+        """One watchdog evaluation against the injected clock: if the round
+        state made no progress for _stall_factor × the current round's full
+        (escalated) timeout budget, assume our announcements or timers were
+        lost — re-broadcast our round step + observed majorities through the
+        reactor hook and re-arm the current step's timeout. Every action is
+        idempotent, so a false positive costs a few duplicate messages,
+        never safety. Returns True when the stall action fired."""
+        factor = self._stall_factor
+        if factor <= 0:
+            return False
+        rs = self.rs
+        # Waiting for transactions is idle by design, not a stall.
+        if not self.config.create_empty_blocks and rs.step == STEP_NEW_ROUND:
+            self._last_progress = self.clock.now()
+            return False
+        budget = self.config.round_timeout_budget(rs.round) * factor
+        idle = self.clock.now() - self._last_progress
+        if idle < budget:
+            return False
+        self._last_progress = self.clock.now()  # re-arm before acting
+        self.metrics.consensus_stalls_total.inc()
+        self._log(
+            f"stall watchdog: no progress for {idle:.1f}s at "
+            f"{rs.height}/{rs.round}/{cstypes.STEP_NAMES.get(rs.step, rs.step)}"
+            "; re-announcing round state"
+        )
+        cb = self._on_stall
+        if cb is not None:
             try:
-                with self._mtx:
-                    self._rearm_step_timeout()
+                cb()
             except Exception:
                 pass
+        try:
+            with self._mtx:
+                self._rearm_step_timeout()
+        except Exception:
+            pass
+        return True
 
     def _rearm_step_timeout(self) -> None:
         """Re-schedule the timeout the CURRENT step depends on (the ticker
@@ -812,7 +826,7 @@ class ConsensusState:
         rs.round = round_
         rs.step = STEP_NEW_ROUND
         rs.validators = validators
-        self._last_progress = time.monotonic()
+        self._last_progress = self.clock.now()
         self.metrics.rounds.set(round_)
         if round_ != 0:
             rs.proposal = None
@@ -1419,20 +1433,24 @@ class ConsensusState:
                     f"type={msg_type}: {e}"
                 )
             return None
-        # An in-process FilePV's signature is valid by construction (it just
+        # An in-process signer's signature is valid by construction (it just
         # computed it over exactly these sign bytes) — prove the triple into
         # the verified cache so our own admission is a dict hit instead of a
-        # crypto call or a micro-batch window wait. Remote/untrusted signers
-        # keep the full verify: a byzantine privval must not be able to
-        # plant unverified triples.
+        # crypto call or a micro-batch window wait. FilePV and MockPV both
+        # sign locally with a key this process holds; remote/untrusted
+        # signers keep the full verify — a byzantine privval must not be
+        # able to plant unverified triples.
         try:
             from cometbft_tpu.crypto import ed25519 as _ed
             from cometbft_tpu.privval.file import FilePV as _FilePV
+            from cometbft_tpu.types.priv_validator import MockPV as _MockPV
 
             pk = self.priv_validator_pub_key
-            if isinstance(self.priv_validator, _FilePV) and isinstance(pk, _ed.PubKey):
-                _ed._verified_put(
-                    (pk.bytes(), bytes(vote.signature), vote.sign_bytes(self.state.chain_id))
+            if isinstance(self.priv_validator, (_FilePV, _MockPV)) and isinstance(
+                pk, _ed.PubKey
+            ):
+                _ed.mark_self_signed(
+                    pk.bytes(), vote.sign_bytes(self.state.chain_id), vote.signature
                 )
         except Exception:
             pass

@@ -11,12 +11,14 @@ import queue
 import threading
 
 from cometbft_tpu.consensus.messages import TimeoutInfo
+from cometbft_tpu.simnet.clock import Clock, MonotonicClock
 
 
 class TimeoutTicker:
-    def __init__(self):
+    def __init__(self, clock: Clock | None = None):
         self.tock_queue: queue.Queue[TimeoutInfo] = queue.Queue()
-        self._timer: threading.Timer | None = None
+        self.clock = clock or MonotonicClock()
+        self._timer = None  # TimerHandle of the single pending timeout
         self._mtx = threading.Lock()
         self._running = False
 
@@ -37,9 +39,7 @@ class TimeoutTicker:
                 return
             if self._timer is not None:
                 self._timer.cancel()
-            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
-            self._timer.daemon = True
-            self._timer.start()
+            self._timer = self.clock.timer(ti.duration, self._fire, ti)
 
     def _fire(self, ti: TimeoutInfo) -> None:
         self.tock_queue.put(ti)

@@ -40,7 +40,7 @@ import traceback
 
 from cometbft_tpu.e2e_runner import Manifest
 
-PROFILES = ("full", "small")
+PROFILES = ("full", "small", "sim")
 
 # Weighted sampling tables (generator/generate.go's uniformChoice /
 # weightedChoice analogs).  Non-ed25519 verification is pure Python here —
@@ -75,6 +75,8 @@ def generate_spec(seed: int, profile: str = "full") -> dict:
     if profile not in PROFILES:
         raise ValueError(f"unknown profile {profile!r} (want one of {PROFILES})")
     rng = random.Random(f"{profile}:{seed}")
+    if profile == "sim":
+        return _generate_sim_spec(seed, rng)
     small = profile == "small"
 
     n_validators = rng.choice((2, 3, 4) if small else (2, 3, 4, 4, 5, 6))
@@ -184,9 +186,49 @@ def generate_spec(seed: int, profile: str = "full") -> dict:
     }
 
 
+def _generate_sim_spec(seed: int, rng: random.Random) -> dict:
+    """The ``sim`` profile: one 50–200 node virtual-clock scenario.
+
+    Samples the WAN shape (zones, jitter, drop), one quorum-breaking
+    partition + heal, and optional churn.  The zone latency matrix itself
+    is synthesized inside the scenario from the same seed, so the manifest
+    stays small while the resolved schedule still lands in repro.json.
+    """
+    validators = rng.choice((50, 50, 75, 100, 100, 150, 200))
+    blocks = rng.randint(6, 10)
+    part_at = round(rng.uniform(15.0, 35.0), 1)
+    sim = {
+        "seed": seed,
+        "validators": validators,
+        "blocks": blocks,
+        "zones": rng.randint(2, 6),
+        "jitter_ms": round(rng.uniform(5.0, 25.0), 1),
+        "drop_p": rng.choice((0.0, 0.0, round(rng.uniform(0.002, 0.02), 4))),
+        "vote_window_ms": rng.choice((0.0, 25.0, 50.0)),
+        "max_sim_s": float(blocks * 40 + 120),
+        "partitions": [{
+            "at_s": part_at,
+            "heal_s": round(part_at + rng.uniform(10.0, 30.0), 1),
+            "fraction": 0.5,
+        }],
+        "churn": (
+            [{
+                "at_s": round(rng.uniform(10.0, 30.0), 1),
+                "down_s": round(rng.uniform(10.0, 25.0), 1),
+                "nodes": rng.randint(1, max(1, validators // 10)),
+            }]
+            if rng.random() < 0.4
+            else []
+        ),
+    }
+    return {"seed": seed, "profile": "sim", "network": "sim", "sim": sim}
+
+
 def render_toml(spec: dict) -> str:
     """Stable TOML rendering: fixed key order, no timestamps — the
     determinism contract is byte-identical output per (seed, profile)."""
+    if spec.get("network") == "sim":
+        return _render_sim_toml(spec)
     lines = [
         "# Randomized e2e testnet manifest "
         f"(seed {spec['seed']}, profile {spec['profile']}).",
@@ -219,6 +261,57 @@ def render_toml(spec: dict) -> str:
         if node["perturb"]:
             quoted = ", ".join(f'"{p}"' for p in node["perturb"])
             lines.append(f"perturb = [{quoted}]")
+    return "\n".join(lines) + "\n"
+
+
+def _render_sim_toml(spec: dict) -> str:
+    """network = "sim" manifests: scalars + flat parallel arrays only (the
+    partition/churn schedules are unzipped — the repo's TOML subset has no
+    inline tables; Manifest._load_sim zips them back)."""
+    sim = spec["sim"]
+    lines = [
+        "# Randomized simnet scenario manifest "
+        f"(seed {spec['seed']}, profile sim).",
+        "# Regenerate: python -m cometbft_tpu.cmd e2e generate "
+        f"--seed {spec['seed']} --profile sim",
+        "",
+        f"seed = {spec['seed']}",
+        'network = "sim"',
+        "",
+        "[sim]",
+        f"seed = {sim['seed']}",
+        f"validators = {sim['validators']}",
+        f"blocks = {sim['blocks']}",
+        f"zones = {sim['zones']}",
+        f"jitter_ms = {sim['jitter_ms']}",
+        f"drop_p = {sim['drop_p']}",
+        f"vote_window_ms = {sim['vote_window_ms']}",
+        f"max_sim_s = {sim['max_sim_s']}",
+    ]
+    parts = sim.get("partitions", [])
+    if parts:
+        lines.append(
+            "partition_at_s = [" + ", ".join(str(p["at_s"]) for p in parts) + "]"
+        )
+        lines.append(
+            "partition_heal_s = ["
+            + ", ".join(str(p["heal_s"]) for p in parts) + "]"
+        )
+        lines.append(
+            "partition_fraction = ["
+            + ", ".join(str(p["fraction"]) for p in parts) + "]"
+        )
+    churn = sim.get("churn", [])
+    if churn:
+        lines.append(
+            "churn_at_s = [" + ", ".join(str(c["at_s"]) for c in churn) + "]"
+        )
+        lines.append(
+            "churn_down_s = [" + ", ".join(str(c["down_s"]) for c in churn) + "]"
+        )
+        lines.append(
+            "churn_nodes = [" + ", ".join(str(c["nodes"]) for c in churn) + "]"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -321,6 +414,10 @@ def _write_repro(sdir, seed, profile, manifest_text, exc, runner) -> str:
         # detected (None for non-stall failures): height/round/step,
         # per-round vote bitmaps, peer round views.
         "round_states": getattr(runner, "last_round_states", None),
+        # network = "sim": the scenario's full resolved schedule (latency
+        # matrix, partition/churn timeline, seeds) — this artifact alone
+        # replays the failing run bit-identically.
+        "sim_schedule": getattr(runner, "sim_schedule", None),
     }
     path = os.path.join(sdir, "repro.json")
     with open(path, "w") as f:

@@ -95,12 +95,19 @@ class Manifest:
     validator_churn: bool = False  # val: tx add/remove mid-run
     light_client: bool = False  # verify the agreed height
     seed: int = -1  # generator seed (informational; -1 = hand-written)
+    network: str = "real"  # real = OS processes; sim = virtual-clock simnet
+    sim: dict = field(default_factory=dict)  # scenario spec (network = "sim")
     nodes: list[ManifestNode] = field(default_factory=list)
 
     @classmethod
     def load(cls, path: str) -> "Manifest":
         with open(path, "rb") as f:
             raw = tomllib.load(f)
+        network = str(raw.get("network", "real"))
+        if network == "sim":
+            return cls._load_sim(raw)
+        if network != "real":
+            raise ValueError(f"unknown network {network!r} (want real | sim)")
         from cometbft_tpu.privval.file import KEY_TYPES
 
         nodes = [
@@ -168,6 +175,48 @@ class Manifest:
             )
         return m
 
+    @classmethod
+    def _load_sim(cls, raw: dict) -> "Manifest":
+        """network = "sim": the [sim] table IS the scenario spec.
+
+        Partition/churn schedules arrive as parallel flat arrays
+        (``partition_at_s``/``partition_heal_s``/``partition_fraction``,
+        ``churn_at_s``/``churn_down_s``/``churn_nodes``) — the TOML subset
+        this repo parses has no inline tables — and are zipped back into
+        the list-of-dicts form ``simnet.scenario.default_spec`` takes.
+        No [node.*] sections: every simulated node is an equal validator.
+        """
+        from cometbft_tpu.simnet.scenario import default_spec
+
+        sim_raw = dict(raw.get("sim", {}))
+        parts = [
+            {"at_s": a, "heal_s": h, "fraction": f}
+            for a, h, f in zip(
+                sim_raw.pop("partition_at_s", []),
+                sim_raw.pop("partition_heal_s", []),
+                sim_raw.pop("partition_fraction", []),
+            )
+        ]
+        churn = [
+            {"at_s": a, "down_s": d, "nodes": n}
+            for a, d, n in zip(
+                sim_raw.pop("churn_at_s", []),
+                sim_raw.pop("churn_down_s", []),
+                sim_raw.pop("churn_nodes", []),
+            )
+        ]
+        if parts:
+            sim_raw["partitions"] = parts
+        if churn:
+            sim_raw["churn"] = churn
+        sim = default_spec(**sim_raw)  # validates: unknown keys raise
+        return cls(
+            network="sim",
+            sim=sim,
+            seed=int(raw.get("seed", sim["seed"])),
+            target_blocks=int(sim["blocks"]),
+        )
+
     def validators(self) -> list[ManifestNode]:
         return [n for n in self.nodes if n.is_validator()]
 
@@ -216,6 +265,10 @@ class E2ERunner:
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
         self.last_round_states: dict | None = None
+        # network = "sim": the scenario's full resolved schedule (latency
+        # matrix, partition/churn timeline, seeds) — repro.json embeds it so
+        # a failing run replays bit-identically from the artifact alone.
+        self.sim_schedule: dict | None = None
 
     # -- setup ------------------------------------------------------------
 
@@ -1060,7 +1113,57 @@ class E2ERunner:
 
     # -- the run ----------------------------------------------------------
 
+    def _run_sim(self) -> dict:
+        """network = "sim": one in-process virtual-clock scenario instead of
+        OS processes. The scenario enforces the same core invariants the
+        real runner does (target height + hash agreement); its resolved
+        schedule is kept for the repro artifact."""
+        from cometbft_tpu.simnet.scenario import run_scenario
+
+        sim = self.manifest.sim
+        self.log(
+            f"simnet: {sim['validators']} validators, "
+            f"{sim['blocks']} blocks, seed {sim['seed']}, "
+            f"{len(sim['partitions'])} partitions, {len(sim['churn'])} churns"
+        )
+        report = run_scenario(dict(sim))
+        self.sim_schedule = report.get("schedule")
+        if not report.get("hash_agreement", True):
+            raise AssertionError(
+                f"simnet hash disagreement at height {report['agreed_height']}"
+            )
+        if not report["ok"]:
+            # Height never reached: the stall signature (run_matrix maps
+            # TimeoutError to `stalled`, same as a wall-clock wait_height).
+            raise TimeoutError(
+                f"simnet: height {sim['blocks'] + 1} not reached "
+                f"(node0 at {report['height_node0']} after "
+                f"{report['sim_time_s']} sim-s)"
+            )
+        self.log(
+            f"simnet: height {report['height_node0']} in "
+            f"{report['sim_time_s']} sim-s / {report['wall_time_s']} wall-s "
+            f"({report['accel']}x), {report['events']} events"
+        )
+        return {
+            "network": "sim",
+            "nodes": report["validators"],
+            "final_heights": {
+                "min": report["heights_min"], "max": report["heights_max"]
+            },
+            **{
+                k: report[k]
+                for k in (
+                    "seed", "agreed_height", "agreed_hash", "stragglers",
+                    "sim_time_s", "wall_time_s", "accel", "events",
+                    "counters", "block_hashes",
+                )
+            },
+        }
+
     def run(self) -> dict:
+        if self.manifest.network == "sim":
+            return self._run_sim()
         self.setup()
         self.start()
         stop = threading.Event()

@@ -36,10 +36,13 @@ def decode_txs_message(data: bytes) -> list[bytes]:
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, config, mempool):
+    def __init__(self, config, mempool, clock=None):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
         super().__init__("MEMPOOL")
         self.config = config
         self.mempool = mempool
+        self.clock = clock or MonotonicClock()
         self._running = False
         self._peer_sent: dict[str, set] = {}
 
@@ -89,4 +92,4 @@ class MempoolReactor(Reactor):
                 # gossip forever (same backpressure-liveness rule as the
                 # consensus gossip).
                 sent_set.update(keys)
-            time.sleep(0.05)
+            self.clock.sleep(0.05)

@@ -55,11 +55,23 @@ class Node:
         client_creator,
         logger=None,
         custom_reactors: dict | None = None,
+        transport_factory=None,
+        clock=None,
     ):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
         self.config = config
         self.genesis_doc = genesis_doc
         self.priv_validator = priv_validator
         self.logger = logger
+        # Injected time source, threaded into consensus + p2p + blocksync so
+        # a simulated deployment (simnet) controls every timer from one
+        # virtual clock. Default: wall clock, behavior unchanged.
+        self.clock = clock or MonotonicClock()
+        # fn(node_info, node_key, fuzz_config) -> transport duck-typing
+        # MultiplexTransport (listen/dial/close). None = real TCP transport;
+        # simnet injects SimTransport here.
+        self._transport_factory = transport_factory
         # node/node.go CustomReactors option: name -> Reactor, added to the
         # switch after the built-ins (same-name entries replace built-ins in
         # the reference; here extra names only — replacement would need the
@@ -197,6 +209,7 @@ class Node:
             self.event_bus,
             wal=wal,
             metrics=cs_metrics,
+            clock=self.clock,
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
@@ -244,10 +257,14 @@ class Node:
                     max_delay=config.p2p.test_fuzz_max_delay,
                     prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
                 )
+            make_transport = self._transport_factory or (
+                lambda ni, nk, fz: MultiplexTransport(ni, nk, fz)
+            )
             self.switch = Switch(
                 self.node_info,
-                MultiplexTransport(self.node_info, self.node_key, fuzz_config),
+                make_transport(self.node_info, self.node_key, fuzz_config),
                 config=config.p2p,
+                clock=self.clock,
             )
             self.consensus_reactor = ConsensusReactor(
                 self.consensus_state,
@@ -256,7 +273,7 @@ class Node:
             # Gossiped txs enter the same admission path as RPC submissions
             # (preverify + lanes), with the peer id recorded as sender.
             self.mempool_reactor = MempoolReactor(
-                config.mempool, self.ingress or self.mempool
+                config.mempool, self.ingress or self.mempool, clock=self.clock
             )
             self.evidence_reactor = EvidenceReactor(self.evidence_pool)
             self.blocksync_reactor = BlocksyncReactor(
@@ -265,6 +282,7 @@ class Node:
                 self.block_store,
                 block_sync=self._block_sync and not self._state_sync,
                 on_caught_up=self._on_blocksync_caught_up,
+                clock=self.clock,
             )
             self.statesync_reactor = StatesyncReactor(
                 snapshot_conn=self.proxy_app.snapshot

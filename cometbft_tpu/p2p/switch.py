@@ -84,10 +84,16 @@ class Peer:
 class Switch:
     """p2p/switch.go Switch."""
 
-    def __init__(self, node_info: NodeInfo, transport: MultiplexTransport, config=None):
+    def __init__(
+        self, node_info: NodeInfo, transport: MultiplexTransport, config=None,
+        clock=None,
+    ):
+        from cometbft_tpu.simnet.clock import MonotonicClock
+
         self.node_info = node_info
         self.transport = transport
         self.config = config
+        self.clock = clock or MonotonicClock()
         self.reactors: dict[str, object] = {}
         self._chan_to_reactor: dict[int, object] = {}
         self._channel_descs: list[ChannelDescriptor] = []
@@ -235,14 +241,14 @@ class Switch:
                 expected_id = addr.split("@", 1)[0] if "@" in addr else ""
                 if expected_id and self.get_peer(expected_id) is not None:
                     attempt = 0
-                    time.sleep(5)
+                    self.clock.sleep(5)
                     continue
                 try:
                     self.dial_peer(addr)
                     attempt = 0
                 except Exception:
                     attempt += 1
-                    time.sleep(redial_delay(attempt))
+                    self.clock.sleep(redial_delay(attempt))
 
         for addr in self._persistent_addrs:
             threading.Thread(target=redial, args=(addr,), daemon=True).start()

@@ -1,0 +1,44 @@
+"""simnet: deterministic virtual-clock network simulation (round 13).
+
+Three layers:
+
+* :mod:`cometbft_tpu.simnet.clock` — the ``Clock`` abstraction every
+  consensus/p2p timer now goes through: ``MonotonicClock`` (wall time,
+  the production default — behavior identical to the pre-simnet code)
+  and ``SimClock`` (an event-heap virtual clock that advances only when
+  every registered actor is blocked, so simulated seconds cost only the
+  host time needed to drain the events they contain).
+* :mod:`cometbft_tpu.simnet.transport` — ``SimTransport``/``SimConn``:
+  the ``MultiplexTransport``/``UpgradedConn`` surface over in-memory
+  pipes with a seeded per-link latency/jitter/bandwidth/drop model and
+  runtime-scriptable partitions and heals.
+* :mod:`cometbft_tpu.simnet.scenario` — the deterministic scenario
+  harness: 50-200 in-process validators on ONE ``SimClock``, WAN latency
+  matrices, partition/churn schedules, replayable bit-identically from
+  the seed (``network = "sim"`` e2e manifests route here).
+"""
+
+from cometbft_tpu.simnet.clock import Clock, MonotonicClock, SimClock
+
+__all__ = [
+    "Clock",
+    "MonotonicClock",
+    "SimClock",
+    "SimNetwork",
+    "SimTransport",
+    "run_scenario",
+]
+
+
+def __getattr__(name):
+    # Lazy: scenario/transport import consensus+p2p, which themselves import
+    # simnet.clock — an eager import here would be circular.
+    if name in ("SimNetwork", "SimTransport"):
+        from cometbft_tpu.simnet import transport
+
+        return getattr(transport, name)
+    if name == "run_scenario":
+        from cometbft_tpu.simnet.scenario import run_scenario
+
+        return run_scenario
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,650 @@
+"""Deterministic 50–200 node consensus scenarios on one SimClock.
+
+The harness runs N full ``ConsensusState`` machines (real executor, real
+ABCI kvstore app, real mempool, real crypto — only the WAL is nil and the
+wire is virtual) **single-threaded**: nothing calls ``cs.start()``.
+Instead of receive/tock/watchdog threads, every external stimulus is a
+SimClock event —
+
+* a :class:`SimTicker` turns ``schedule_timeout`` into a clock event whose
+  callback enqueues the tock and synchronously drains that node's queue;
+* each node's ``set_broadcast`` fan-out schedules per-peer deliveries at
+  ``now + latency(zone_i, zone_j) + jitter`` (seeded), subject to drop and
+  the scripted partition state;
+* partitions, heals, churn, tx load, and the per-node stall-watchdog
+  check are themselves clock events scheduled from the spec.
+
+Because the driver pops events in ``(due, seq)`` order from one heap and
+``cmttime.now()`` is virtualized onto the same clock, two runs of the
+same spec produce *bit-identical* blocks — same timestamps, same votes,
+same hashes — while wall time is only the Python/crypto work, typically
+an order of magnitude less than the simulated chain time.
+
+Vote-batch modeling: with ``vote_window_ms`` set, vote deliveries are
+quantized up to window boundaries and delivered per (node, window)
+bucket, pre-verified in one ``_prebatch_vote_signatures`` dispatch —
+the sim-side analogue of ``CMTPU_VOTE_BATCH_WINDOW_MS``.
+
+Non-goals (see ops/DESIGN.md round 13): no device-call simulation —
+verification backends run for real; no blocksync in-harness, so churned
+nodes that miss blocks are reported as stragglers rather than caught up.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import random
+import time as _time
+
+from cometbft_tpu.consensus.ticker import TimeoutTicker
+from cometbft_tpu.simnet.clock import SimClock
+
+GENESIS_SECONDS = 1_700_000_000
+
+
+class SimTicker(TimeoutTicker):
+    """Single-pending-timeout ticker whose tocks go straight to a sink
+    callback (no tock queue, no pump thread)."""
+
+    def __init__(self, clock: SimClock, sink):
+        super().__init__(clock=clock)
+        self._sink = sink
+
+    def _fire(self, ti) -> None:
+        self._sink(ti)
+
+
+def default_spec(**overrides) -> dict:
+    """Baseline WAN scenario; every field overridable (generator/manifest)."""
+    spec = {
+        "seed": 0,
+        "validators": 50,
+        "blocks": 10,  # target committed height
+        "zones": 4,
+        "zone_latency_ms": None,  # NxN (zones); synthesized from seed if None
+        "jitter_ms": 10.0,
+        "drop_p": 0.0,
+        "vote_window_ms": 0.0,
+        # WAN-ish consensus timeouts (seconds, simulated).
+        "timeout_propose": 3.0,
+        "timeout_propose_delta": 0.5,
+        "timeout_prevote": 1.0,
+        "timeout_prevote_delta": 0.5,
+        "timeout_precommit": 1.0,
+        "timeout_precommit_delta": 0.5,
+        # WAN-realistic commit dwell (Cosmos Hub mainnet ships 5s). Sim
+        # dead time costs no wall time — the clock jumps it — so a
+        # realistic dwell is free and keeps block cadence honest.
+        "timeout_commit": 5.0,
+        "partitions": [],  # [{"at_s", "heal_s", "fraction"}]
+        "churn": [],  # [{"at_s", "down_s", "nodes"}] nodes = count, never node 0
+        "tx_interval_s": 0.0,  # 0 = no load
+        "txs_per_interval": 1,
+        "max_sim_s": 600.0,
+        "watchdog_poll_s": 2.0,
+        # Lower than the production default (10): sim recovery from a
+        # heal should take round-budgets, not minutes of sim time.
+        "stall_factor": 4.0,
+    }
+    unknown = set(overrides) - set(spec)
+    if unknown:
+        raise ValueError(f"unknown simnet spec keys {sorted(unknown)}")
+    spec.update(overrides)
+    return spec
+
+
+def _synth_zone_latency(rng: random.Random, zones: int) -> list[list[float]]:
+    """Symmetric zone-pair base latency (ms): LAN-ish intra, WAN inter."""
+    m = [[0.0] * zones for _ in range(zones)]
+    for a in range(zones):
+        m[a][a] = rng.uniform(2.0, 15.0)
+        for b in range(a + 1, zones):
+            m[a][b] = m[b][a] = rng.uniform(40.0, 150.0)
+    return m
+
+
+class _SimNode:
+    __slots__ = ("index", "name", "cs", "mempool", "app", "online")
+
+    def __init__(self, index, name, cs, mempool, app):
+        self.index = index
+        self.name = name
+        self.cs = cs
+        self.mempool = mempool
+        self.app = app
+        self.online = True
+
+
+class Scenario:
+    """One seeded run. Build with a spec dict (see default_spec), then
+    :meth:`run` to completion; ``report`` holds the result + full schedule."""
+
+    def __init__(self, spec: dict):
+        self.spec = dict(spec)
+        self.seed = int(spec["seed"])
+        self.rng = random.Random(f"simnet:{self.seed}")
+        self.clock = SimClock()
+        self.n = int(spec["validators"])
+        self.nodes: list[_SimNode] = []
+        self._groups: list[set[int]] | None = None
+        self._vote_buckets: dict[tuple[int, int], list] = {}
+        # FIFO clamp per directed link (i, j): jitter may stretch a
+        # stream, never reorder it — parts must not overtake their
+        # proposal (a part arriving first is dropped, as in state.go).
+        self._fifo: dict[tuple[int, int], float] = {}
+        self._tx_counter = 0
+        self.counters = {
+            "deliveries": 0,
+            "dropped": 0,
+            "partitioned": 0,
+            "offline_skips": 0,
+            "vote_dispatches": 0,
+            "stall_fires": 0,
+            "catchups": 0,
+        }
+        self.schedule = {}  # realized schedule, filled by _build/_script
+
+    # -- assembly -------------------------------------------------------------
+
+    def _build(self) -> None:
+        from cometbft_tpu.abci.example.kvstore import KVStoreApplication
+        from cometbft_tpu.config import test_config
+        from cometbft_tpu.consensus.state import ConsensusState
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.mempool import CListMempool
+        from cometbft_tpu.proxy import AppConns, local_client_creator
+        from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.types import GenesisDoc, GenesisValidator, Time
+        from cometbft_tpu.types.priv_validator import MockPV
+
+        spec = self.spec
+        pvs = [
+            MockPV(
+                priv_key=ed25519.gen_priv_key_from_secret(
+                    f"simnet:{self.seed}:val{i}".encode()
+                )
+            )
+            for i in range(self.n)
+        ]
+        gen_vals = [
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, f"sim{i}")
+            for i, pv in enumerate(pvs)
+        ]
+        gen = GenesisDoc(
+            chain_id=f"simnet-{self.seed}",
+            genesis_time=Time(GENESIS_SECONDS, 0),
+            validators=gen_vals,
+        )
+        gen.validate_and_complete()
+
+        zones = int(spec["zones"])
+        self.zone_of = [i % zones for i in range(self.n)]
+        zl = spec["zone_latency_ms"] or _synth_zone_latency(self.rng, zones)
+        self.zone_latency_ms = [[float(x) for x in row] for row in zl]
+        self.jitter_s = float(spec["jitter_ms"]) / 1000.0
+        self.drop_p = float(spec["drop_p"])
+        self.vote_window_s = float(spec["vote_window_ms"]) / 1000.0
+
+        for i, pv in enumerate(pvs):
+            state = make_genesis_state(gen)
+            app = KVStoreApplication()
+            conns = AppConns(local_client_creator(app))
+            conns.start()
+            cfg = test_config()
+            for k in (
+                "timeout_propose", "timeout_propose_delta",
+                "timeout_prevote", "timeout_prevote_delta",
+                "timeout_precommit", "timeout_precommit_delta",
+                "timeout_commit",
+            ):
+                setattr(cfg.consensus, k, float(spec[k]))
+            cfg.consensus.skip_timeout_commit = False
+            mempool = CListMempool(cfg.mempool, conns.mempool)
+            state_store = StateStore(MemDB())
+            block_store = BlockStore(MemDB())
+            state_store.save(state)
+            executor = BlockExecutor(
+                state_store, conns.consensus, mempool, None, block_store
+            )
+            sink = self._make_tock_sink(i)
+            ticker = SimTicker(self.clock, sink)
+            cs = ConsensusState(
+                cfg.consensus,
+                state,
+                executor,
+                block_store,
+                mempool,
+                wal=None,
+                ticker=ticker,
+                clock=self.clock,
+                name=f"sim{i}",
+            )
+            cs.set_priv_validator(pv)
+            cs._stall_factor = float(spec["stall_factor"])
+            cs.set_broadcast(self._make_broadcast(i))
+            node = _SimNode(i, f"sim{i}", cs, mempool, app)
+            cs.set_on_stall(self._make_on_stall(node))
+            self.nodes.append(node)
+
+        self.schedule = {
+            "seed": self.seed,
+            "validators": self.n,
+            "zones": zones,
+            "zone_of": list(self.zone_of),
+            "zone_latency_ms": self.zone_latency_ms,
+            "jitter_ms": float(spec["jitter_ms"]),
+            "drop_p": self.drop_p,
+            "vote_window_ms": float(spec["vote_window_ms"]),
+            "timeouts": {
+                k: float(spec[k])
+                for k in (
+                    "timeout_propose", "timeout_propose_delta",
+                    "timeout_prevote", "timeout_prevote_delta",
+                    "timeout_precommit", "timeout_precommit_delta",
+                    "timeout_commit",
+                )
+            },
+            "partitions": [],
+            "churn": [],
+        }
+
+    # -- event plumbing -------------------------------------------------------
+
+    def _make_tock_sink(self, i: int):
+        def sink(ti):
+            node = self.nodes[i]
+            node.cs._queue.put(("timeout", ti, ""))
+            self._drain(node)
+        return sink
+
+    def _make_on_stall(self, node: _SimNode):
+        """Reactor-gossip analogue: a stalled node re-announces its OWN
+        contribution to the current round — proposal + parts (if it holds
+        the complete block) and its own votes. A quorum-wide stall thus
+        re-announces the whole vote set exactly once collectively (each
+        voter re-sends itself), instead of every node flooding everything
+        it knows; cross-height gaps are the catchup path's job. Everything
+        is idempotent at the receivers, mirroring the real reactor's
+        NewRoundStep/maj23 stall re-broadcast."""
+        from cometbft_tpu.consensus.messages import (
+            BlockPartMessage, ProposalMessage, VoteMessage,
+        )
+
+        def on_stall():
+            self.counters["stall_fires"] += 1
+            cs = node.cs
+            rs = cs.rs
+            bc = cs._broadcast
+            if bc is None or not node.online:
+                return
+            if rs.proposal is not None:
+                bc(ProposalMessage(rs.proposal))
+            parts = rs.proposal_block_parts
+            if parts is not None and parts.is_complete():
+                for k in range(parts.total):
+                    bc(BlockPartMessage(rs.height, rs.round, parts.get_part(k)))
+            addr = cs.priv_validator_pub_key.address() if cs.priv_validator_pub_key else None
+            if addr is None:
+                return
+            for vs in (rs.votes.prevotes(rs.round), rs.votes.precommits(rs.round)):
+                if vs is None:
+                    continue
+                own = vs.get_by_address(addr)
+                if own is not None:
+                    bc(VoteMessage(own))
+        return on_stall
+
+    def _catchup(self, node: _SimNode) -> None:
+        """Consensus-reactor catchup-gossip analogue: a peer that already
+        committed this node's current height re-sends that height's
+        precommits (from its seen commit) and block parts. The precommits
+        arrive first so the 2/3-majority path re-creates the PartSet from
+        the committed block_id, then the parts complete it and the node
+        finalizes — exactly the lagging-peer flow of reactor.go."""
+        from cometbft_tpu.consensus.messages import BlockPartMessage, VoteMessage
+        from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
+
+        h = node.cs.rs.height
+        donor = next(
+            (d for d in self.nodes
+             if d.online and d.index != node.index and d.cs.rs.height > h
+             and self._reachable(d.index, node.index)),
+            None,
+        )
+        if donor is None:
+            return
+        seen = donor.cs.block_store.load_seen_commit(h)
+        block = donor.cs.block_store.load_block(h)
+        if seen is None or block is None:
+            return
+        self.counters["catchups"] += 1
+        msgs = []
+        for idx, sig in enumerate(seen.signatures):
+            if sig.is_absent():
+                continue
+            msgs.append(VoteMessage(Vote(
+                type=PRECOMMIT_TYPE,
+                height=seen.height,
+                round=seen.round,
+                block_id=sig.block_id(seen.block_id),
+                timestamp=sig.timestamp,
+                validator_address=sig.validator_address,
+                validator_index=idx,
+                signature=sig.signature,
+            )))
+        parts = block.make_part_set()
+        for k in range(parts.total):
+            msgs.append(BlockPartMessage(h, seen.round, parts.get_part(k)))
+        for msg in msgs:
+            self._send_direct(donor.index, node.index, msg)
+
+    def _send_direct(self, i: int, j: int, msg) -> None:
+        due = max(
+            self.clock.now() + self._link_delay(i, j),
+            self._fifo.get((i, j), 0.0),
+        )
+        self._fifo[(i, j)] = due
+        self.clock.timer(due - self.clock.now(), self._deliver, j, msg, f"sim{i}")
+
+    def _drain(self, node: _SimNode) -> None:
+        """Synchronous stand-in for _receive_routine: process everything
+        queued on this node (own internal messages re-enter mid-drain)."""
+        cs = node.cs
+        while True:
+            try:
+                kind, payload, peer_id = cs._queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                with cs._mtx:
+                    if kind == "timeout":
+                        cs._handle_timeout(payload)
+                    else:
+                        cs._handle_msg(payload, peer_id)
+            except Exception:
+                import traceback
+                print(f"[{node.name}] sim drain failure: {traceback.format_exc()}")
+
+    def _reachable(self, a: int, b: int) -> bool:
+        if self._groups is None:
+            return True
+        ga = next((g for g in self._groups if a in g), None)
+        gb = next((g for g in self._groups if b in g), None)
+        if ga is None or gb is None:
+            return True
+        return ga is gb
+
+    def _link_delay(self, a: int, b: int) -> float:
+        d = self.zone_latency_ms[self.zone_of[a]][self.zone_of[b]] / 1000.0
+        if self.jitter_s > 0:
+            d += self.rng.random() * self.jitter_s
+        return d
+
+    def _make_broadcast(self, i: int):
+        from cometbft_tpu.consensus.messages import VoteMessage
+
+        def broadcast(msg):
+            if not self.nodes[i].online:
+                self.counters["offline_skips"] += 1
+                return
+            is_vote = self.vote_window_s > 0 and isinstance(msg, VoteMessage)
+            peer_id = f"sim{i}"
+            for j in range(self.n):
+                if j == i:
+                    continue
+                if not self._reachable(i, j):
+                    self.counters["partitioned"] += 1
+                    continue
+                if self.drop_p > 0 and self.rng.random() < self.drop_p:
+                    self.counters["dropped"] += 1
+                    continue
+                due = max(
+                    self.clock.now() + self._link_delay(i, j),
+                    self._fifo.get((i, j), 0.0),
+                )
+                if is_vote:
+                    self._bucket_vote(i, j, due, msg, peer_id)
+                else:
+                    self._fifo[(i, j)] = due
+                    self.clock.timer(
+                        due - self.clock.now(), self._deliver, j, msg, peer_id
+                    )
+        return broadcast
+
+    def _deliver(self, j: int, msg, peer_id: str) -> None:
+        node = self.nodes[j]
+        if not node.online:
+            self.counters["offline_skips"] += 1
+            return
+        self.counters["deliveries"] += 1
+        node.cs._queue.put(("peer", msg, peer_id))
+        self._drain(node)
+
+    # Vote-window quantization: deliveries round UP to the next window
+    # boundary and land as one per-(node, window) bucket, pre-verified in a
+    # single batch dispatch — deterministic, and the dispatch count drops
+    # by ~the bucket fill factor (the sim analogue of the vote-batch knob).
+    def _bucket_vote(self, i: int, j: int, due: float, msg, peer_id: str) -> None:
+        w = self.vote_window_s
+        slot = int(math.floor(due / w)) + 1
+        self._fifo[(i, j)] = slot * w
+        key = (j, slot)
+        bucket = self._vote_buckets.get(key)
+        if bucket is None:
+            self._vote_buckets[key] = [(msg, peer_id)]
+            self.clock.timer(slot * w - self.clock.now(), self._flush_votes, key)
+        else:
+            bucket.append((msg, peer_id))
+
+    def _flush_votes(self, key) -> None:
+        j, _slot = key
+        bucket = self._vote_buckets.pop(key, [])
+        node = self.nodes[j]
+        if not bucket or not node.online:
+            self.counters["offline_skips"] += 0 if not bucket else len(bucket)
+            return
+        items = [("peer", m, pid) for m, pid in bucket]
+        self.counters["vote_dispatches"] += 1
+        self.counters["deliveries"] += len(items)
+        if len(items) >= 8:
+            node.cs._prebatch_vote_signatures(items)
+        for item in items:
+            node.cs._queue.put(item)
+        self._drain(node)
+
+    # -- scripted schedule ----------------------------------------------------
+
+    def _script(self) -> None:
+        spec = self.spec
+        for p in spec["partitions"]:
+            at = float(p["at_s"])
+            heal = float(p["heal_s"])
+            frac = float(p.get("fraction", 0.5))
+            k = max(1, min(self.n - 1, int(round(self.n * frac))))
+            groups = [set(range(k)), set(range(k, self.n))]
+            self.clock.timer(at, self._set_partition, groups)
+            self.clock.timer(heal, self._set_partition, None)
+            self.schedule["partitions"].append(
+                {"at_s": at, "heal_s": heal, "fraction": frac,
+                 "group_sizes": [k, self.n - k]}
+            )
+        for c in spec["churn"]:
+            at = float(c["at_s"])
+            down = float(c["down_s"])
+            count = min(int(c.get("nodes", 1)), max(self.n // 3 - 1, 0))
+            # Node 0 is the reference node for hashes: never churn it.
+            picked = self.rng.sample(range(1, self.n), count) if count else []
+            for idx in picked:
+                self.clock.timer(at, self._set_online, idx, False)
+                self.clock.timer(at + down, self._set_online, idx, True)
+            self.schedule["churn"].append(
+                {"at_s": at, "down_s": down, "nodes": sorted(picked)}
+            )
+        if float(spec["tx_interval_s"]) > 0:
+            self.clock.timer(float(spec["tx_interval_s"]), self._inject_txs)
+        poll = float(spec["watchdog_poll_s"])
+        if poll > 0:
+            for i in range(self.n):
+                self.clock.timer(poll, self._watchdog_tick, i)
+
+    def _set_partition(self, groups) -> None:
+        self._groups = groups
+
+    def _set_online(self, idx: int, online: bool) -> None:
+        node = self.nodes[idx]
+        node.online = online
+        if online:
+            # Back from the dead: rearm whatever timer the current step
+            # needs and reset the stall baseline.
+            cs = node.cs
+            cs._last_progress = self.clock.now()
+            with cs._mtx:
+                cs._rearm_step_timeout()
+
+    def _inject_txs(self) -> None:
+        spec = self.spec
+        for _ in range(int(spec["txs_per_interval"])):
+            target = self.nodes[self._tx_counter % self.n]
+            if target.online:
+                tx = f"sim{self.seed}-tx{self._tx_counter}=v".encode()
+                try:
+                    target.mempool.check_tx(tx)
+                except Exception:
+                    pass  # full mempool under load is expected
+                self._drain(target)
+            self._tx_counter += 1
+        self.clock.timer(float(spec["tx_interval_s"]), self._inject_txs)
+
+    def _watchdog_tick(self, i: int) -> None:
+        node = self.nodes[i]
+        if node.online:
+            cs = node.cs
+            cs._stall_check()
+            # Height straggler (missed a commit to drops/partition/churn):
+            # after one round-0 budget of idleness, a caught-up peer
+            # re-serves that height (reactor catchup-gossip analogue).
+            idle = self.clock.now() - cs._last_progress
+            if idle > cs.config.round_timeout_budget(0):
+                self._catchup(node)
+            self._drain(node)
+        self.clock.timer(float(self.spec["watchdog_poll_s"]), self._watchdog_tick, i)
+
+    # -- run ------------------------------------------------------------------
+
+    def run(self) -> dict:
+        import gc
+        import os
+
+        from cometbft_tpu.crypto import sigbatch
+        from cometbft_tpu.types import cmttime
+        from cometbft_tpu.types.cmttime import Time
+
+        target_height = int(self.spec["blocks"]) + 1
+        horizon = float(self.spec["max_sim_s"])
+        wall_start = _time.monotonic()
+
+        def sim_now() -> Time:
+            ns = GENESIS_SECONDS * 10**9 + int(self.clock.now() * 1e9)
+            return Time(ns // 10**9, ns % 10**9)
+
+        cmttime.set_now_source(sim_now)
+        # The wall-clock vote-admission micro-batch would make every scalar
+        # verify wait out a real window with no concurrent producers to
+        # share it (the harness is single-threaded) — the sim models vote
+        # batching virtually instead (vote_window_ms).
+        prev_window = os.environ.get("CMTPU_VOTE_BATCH_WINDOW_MS")
+        os.environ["CMTPU_VOTE_BATCH_WINDOW_MS"] = "0"
+        sigbatch.reset()
+        # The drive loop allocates millions of short-lived objects against
+        # a large persistent heap (N nodes × stores × caches): generational
+        # GC passes dominate wall time and grow with heap size, making
+        # back-to-back runs progressively slower. The harness has no
+        # reference cycles it needs collected mid-run.
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            self._build()
+            self._script()
+            for node in self.nodes:
+                node.cs.ticker.start()
+                node.cs._schedule_round0()
+            cs0 = self.nodes[0].cs
+            while (
+                cs0.rs.height < target_height
+                and self.clock.now() < horizon
+                and self.clock.step()
+            ):
+                pass
+        finally:
+            cmttime.set_now_source(None)
+            if prev_window is None:
+                os.environ.pop("CMTPU_VOTE_BATCH_WINDOW_MS", None)
+            else:
+                os.environ["CMTPU_VOTE_BATCH_WINDOW_MS"] = prev_window
+            sigbatch.reset()
+            for node in self.nodes:
+                node.cs.ticker.stop()
+
+        wall = _time.monotonic() - wall_start
+        if gc_was_enabled:
+            gc.enable()
+            gc.collect()
+        sim_time = self.clock.now()
+        heights = [n.cs.rs.height for n in self.nodes]
+        committed = min(cs0.rs.height - 1, int(self.spec["blocks"]))
+        hashes = {}
+        for h in range(1, committed + 1):
+            blk = self.nodes[0].cs.block_store.load_block(h)
+            hashes[h] = blk.hash().hex() if blk is not None else None
+        reached = cs0.rs.height >= target_height
+        # Hash agreement (the e2e runner's invariant, in-process form):
+        # every node that committed the highest common height must hold the
+        # bit-identical block there. Stragglers below it are exempt — they
+        # are reported, not silently passed.
+        common = 0
+        agreed_hash = None
+        agreement = True
+        if committed >= 1:
+            common = min(
+                [committed]
+                + [h - 1 for h in heights if h - 1 >= 1 and h >= cs0.rs.height - 1]
+            )
+            agreed_hash = hashes.get(common)
+            for node in self.nodes:
+                if node.cs.rs.height - 1 < common:
+                    continue
+                blk = node.cs.block_store.load_block(common)
+                if blk is None or blk.hash().hex() != agreed_hash:
+                    agreement = False
+        return {
+            "ok": reached and agreement,
+            "seed": self.seed,
+            "validators": self.n,
+            "blocks_target": int(self.spec["blocks"]),
+            "height_node0": cs0.rs.height,
+            "heights_min": min(heights),
+            "heights_max": max(heights),
+            "stragglers": [
+                i for i, h in enumerate(heights) if h < cs0.rs.height - 1
+            ],
+            "block_hashes": hashes,
+            "agreed_height": common,
+            "agreed_hash": agreed_hash,
+            "hash_agreement": agreement,
+            "sim_time_s": round(sim_time, 6),
+            "wall_time_s": round(wall, 6),
+            "accel": round(sim_time / wall, 3) if wall > 0 else None,
+            "events": self.clock.events_run,
+            "counters": dict(self.counters),
+            "schedule": self.schedule,
+        }
+
+
+def run_scenario(spec: dict | None = None, **overrides) -> dict:
+    """Build + run one seeded scenario; returns the report dict (the
+    ``schedule`` key is sufficient to replay the run bit-identically)."""
+    full = default_spec(**{**(spec or {}), **overrides})
+    return Scenario(full).run()

@@ -153,10 +153,19 @@ class Header:
 
     def hash(self) -> bytes | None:
         """Merkle root over the 14 encoded fields (types/block.go:440-475).
-        None when ValidatorsHash is missing (header not yet complete)."""
+        None when ValidatorsHash is missing (header not yet complete).
+
+        Memoized per instance (frozen dataclass; the cache lives in
+        __dict__, outside __eq__/__hash__): consensus compares
+        proposal/locked block hashes on every vote admission, and at
+        scenario scale that re-merkleization dominates the profile.
+        """
         if not self.validators_hash:
             return None
-        return merkle.hash_from_byte_slices(
+        cached = self.__dict__.get("_hash_memo")
+        if cached is not None:
+            return cached
+        hv = merkle.hash_from_byte_slices(
             [
                 self.version.encode(),
                 cdc_encode_string(self.chain_id),
@@ -174,6 +183,8 @@ class Header:
                 cdc_encode_bytes(self.proposer_address),
             ]
         )
+        object.__setattr__(self, "_hash_memo", hv)
+        return hv
 
     def encode(self) -> bytes:
         """proto Header (non-nullable version/time/last_block_id always emitted)."""

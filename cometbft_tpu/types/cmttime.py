@@ -97,9 +97,26 @@ def _gmtime_neg(secs: int):
 
 ZERO = Time()
 
+# Pluggable time source (simnet): when set, now() reads virtual time so
+# block/vote timestamps are deterministic under a SimClock. Production
+# never touches this — the wall clock stays the default.
+_now_source = None
+
+
+def set_now_source(fn) -> None:
+    """Install ``fn() -> Time`` as the source behind now() (None resets).
+
+    Process-global: only the single-threaded simnet scenario harness uses
+    it, and always restores None before returning.
+    """
+    global _now_source
+    _now_source = fn
+
 
 def now() -> Time:
     """Current UTC time (types/time.Now is UTC + monotonic-stripped)."""
+    if _now_source is not None:
+        return _now_source()
     ns = _time.time_ns()
     return Time(ns // 10**9, ns % 10**9)
 

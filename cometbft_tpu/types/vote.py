@@ -34,10 +34,21 @@ class Vote:
     signature: bytes = b""
 
     def sign_bytes(self, chain_id: str) -> bytes:
-        """VoteSignBytes (types/vote.go:85-95)."""
-        return canonical.vote_sign_bytes_from_parts(
+        """VoteSignBytes (types/vote.go:85-95).
+
+        Memoized per instance: a gossiped vote is sign-bytes-checked by
+        every admission path it crosses (prebatch, VoteSet, evidence), and
+        in-process meshes share one Vote object across all receivers. The
+        cache never enters __eq__/__hash__ (dataclass uses fields only).
+        """
+        cached = self.__dict__.get("_sign_bytes")
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
+        sb = canonical.vote_sign_bytes_from_parts(
             chain_id, self.type, self.height, self.round, self.block_id, self.timestamp
         )
+        object.__setattr__(self, "_sign_bytes", (chain_id, sb))
+        return sb
 
     def verify(self, chain_id: str, pub_key) -> None:
         """types/vote.go Verify: address match + signature check."""

@@ -94,6 +94,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "simnet: deterministic virtual-clock network tests (SimClock "
+        "ordering, SimTransport link model/partitions, 50-node scenario "
+        "determinism, sim e2e manifests); fast paths run in tier-1, the "
+        "100-node acceptance scenario carries `slow` too — `-m simnet` "
+        "selects just this group",
+    )
+    config.addinivalue_line(
+        "markers",
         "agg: aggregate BLS commit tests (BN254 aggregate wire form, "
         "three-mode verify bit-parity, poisoned-aggregate rejection, "
         "device multi-pairing kernel); fast paths run in tier-1, the "

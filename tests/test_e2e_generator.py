@@ -36,6 +36,15 @@ def test_generated_manifests_validate(tmp_path):
             p.write_text(generate(seed, profile))
             m = Manifest.load(str(p))
             assert m.seed == seed
+            if profile == "sim":
+                # Virtual-clock manifests have no process nodes; the
+                # schema contract is the validated sim spec itself.
+                assert m.network == "sim"
+                assert 50 <= m.sim["validators"] <= 200
+                assert m.target_blocks == m.sim["blocks"] > 0
+                for part in m.sim["partitions"]:
+                    assert part["heal_s"] > part["at_s"] >= 0
+                continue
             first = m.nodes[0]
             assert first.is_validator() and first.start_at == 0
             for n in m.nodes:

@@ -491,10 +491,15 @@ def test_privval_vote_recovered_when_wal_lost_it(tmp_path, lost_round):
         cs.stop()
 
 
+@pytest.mark.slow
 def test_stall_watchdog_fires_and_counts():
     """A quorumless node (1 of 2 validators running) wedges in PREVOTE with no
     pending timer; the watchdog must fire the on_stall hook and bump the
-    stall counter within a few budgets."""
+    stall counter within a few budgets.
+
+    Wall-clock variant: spends real seconds polling. The deterministic
+    virtual-clock equivalent (test_simnet.py::test_stall_check_is_clock_driven)
+    covers the same machinery in tier-1 with zero sleeps."""
     pvs, gen = _mock_genesis(2, chain_id="stall-chain")
     cfg = make_test_config()
     cfg.consensus.stall_watchdog_factor = 0.5
