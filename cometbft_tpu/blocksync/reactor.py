@@ -19,6 +19,7 @@ import time
 from cometbft_tpu.blocksync.pool import BlockPool
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.p2p.reactor import BLOCKSYNC_CHANNEL, Reactor
+from cometbft_tpu.sidecar import engine
 from cometbft_tpu.types.block import Block, BlockID
 from cometbft_tpu.wire import proto as wire
 
@@ -264,7 +265,11 @@ class BlocksyncReactor(Reactor):
                 covered += 1
             self._prefetched_to = self.pool.height + max(covered, 1)
             if covered >= 2 and len(bv):
-                bv.verify()  # populates the cache; bad sigs fall to per-block
+                # Blocksync-class engine admission (the untagged default,
+                # made explicit): window pre-verify yields to consensus
+                # votes but outranks ingress and light prewarm.
+                with engine.submission_class(engine.CLASS_BLOCKSYNC):
+                    bv.verify()  # populates the cache; bad sigs fall to per-block
         except Exception:
             self._prefetched_to = self.pool.height + 1
 

@@ -557,7 +557,13 @@ class ConsensusState:
                 bv.add(val.pub_key, sb, v.signature)
                 keys.append(key)
             if len(bv) >= 8:
-                _, bits = bv.verify()
+                from cometbft_tpu.sidecar import engine as _engine
+
+                # Consensus-class engine admission: drained vote queues go
+                # to the head of the shared device queue under the
+                # admission deadline.
+                with _engine.submission_class(_engine.CLASS_CONSENSUS):
+                    _, bits = bv.verify()
                 for key, valid in zip(keys, bits):
                     if not valid:
                         if len(self._failed_triples) >= self._FAILED_TRIPLES_MAX:
@@ -688,7 +694,10 @@ class ConsensusState:
                     if not cs.is_absent():
                         bv.add(vals.validators[idx].pub_key, sbs[idx], cs.signature)
                 if len(bv) >= 2:
-                    bv.verify()
+                    from cometbft_tpu.sidecar import engine as _engine
+
+                    with _engine.submission_class(_engine.CLASS_CONSENSUS):
+                        bv.verify()
         except Exception:
             pass
         vote_set = VoteSet(

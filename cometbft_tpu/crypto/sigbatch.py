@@ -3,17 +3,26 @@
 `VoteSet.add_vote` (and evidence duplicate-vote checks) verify ONE signature
 at a time, but under gossip many admissions run concurrently — one per peer
 connection, across every in-process node in devnet. This module gives those
-scalar callers the same treatment PR 5 gave ingress: callers block on a
-shared window (`CMTPU_VOTE_BATCH_WINDOW_MS`, default 2 ms from the first
-waiter) and a dispatcher merges everything queued into ONE
-`ed25519.BatchVerifier` call — which already carries the verified-triple
-cache filter, within-batch dedup, the coalescing scheduler → supervised
-backend chain, and the scalar ZIP-215 fallback on chain exhaustion.
+scalar callers consensus-class admission into the continuous-batching
+verification engine (round 14, `sidecar/engine.py`): each caller submits
+its pending triples tagged CLASS_CONSENSUS and the engine merges everything
+queued — across vote sets, peers AND the other traffic classes — into the
+next device dispatch, draining votes ahead of bulk work under a deadline
+bound. Cache semantics are unchanged: pending triples are filtered against
+the verified-triple cache here and only VALID dispatched triples populate
+it afterward.
 
-Failure containment mirrors the scheduler: a bad signature is just a False
-lane (never poisons the window), and any dispatch-level error degrades each
-request independently to the scalar `verify_signature` path. Window 0 (the
-env off switch) keeps today's inline scalar behavior exactly.
+When no engine is active (`CMTPU_COALESCE=0`, or a bare backend installed
+by tests/bench) the round-12 private window dispatcher runs instead:
+callers block on a shared window (`CMTPU_VOTE_BATCH_WINDOW_MS`, default
+2 ms from the first waiter) and a dispatcher merges everything queued into
+ONE `ed25519.BatchVerifier` call.
+
+Failure containment is identical on both paths: a bad signature is just a
+False lane (never poisons the window), and any dispatch-level error —
+including a result not arriving within the deadline-derived timeout —
+degrades each request independently to the scalar `verify_signature` path.
+Window 0 (the env off switch) keeps the inline scalar behavior exactly.
 """
 
 from __future__ import annotations
@@ -25,7 +34,30 @@ import time
 _DEFAULT_WINDOW_MS = 2.0
 # A caller never waits forever on the dispatcher: consensus liveness
 # outranks batching, so a wedged dispatch degrades to scalar verification.
+# Used verbatim only when no supervisor deadline is configured — see
+# _result_timeout_s().
 _RESULT_TIMEOUT_S = 30.0
+
+
+def _result_timeout_s() -> float:
+    """How long a caller waits on a dispatch result before degrading to
+    scalar verification. With a supervised per-call deadline configured
+    (`CMTPU_DEADLINE_MS`), the worst honest wall is every tier of the
+    chain burning its retries under that deadline — wait that long, not a
+    hard-coded 30 s, so a wedge degrades in one supervised exhaustion.
+    Deadline 0/unset keeps the legacy 30 s backstop."""
+    try:
+        deadline_ms = float(os.environ.get("CMTPU_DEADLINE_MS", "") or 0.0)
+    except ValueError:
+        deadline_ms = 0.0
+    if deadline_ms <= 0:
+        return _RESULT_TIMEOUT_S
+    try:
+        retries = int(os.environ.get("CMTPU_RETRIES", "") or 2)
+    except ValueError:
+        retries = 2
+    # <= 3 tiers (grpc|tpu -> hybrid -> cpu), each (retries+1) attempts.
+    return max(1.0, deadline_ms / 1000.0 * (retries + 1) * 3)
 
 
 class _Req:
@@ -56,6 +88,7 @@ class SigBatcher:
         self.window_ms = window_ms
         self.max_sigs = max_sigs
         self.inline = inline
+        self.result_timeout_s = _result_timeout_s()
         self._cond = threading.Condition()
         self._queue: list[_Req] = []
         self._thread: threading.Thread | None = None
@@ -115,6 +148,16 @@ class SigBatcher:
             with self._cond:
                 self.scalar_direct += len(pend)
             return bits  # type: ignore[return-value]
+        if not self.inline:
+            eng = self._engine()
+            if eng is not None:
+                # Continuous-batching path: no private window thread — the
+                # engine merges concurrent admissions (and the other
+                # traffic classes) itself, votes first.
+                pbits = self._engine_dispatch(eng, pub_keys, msgs, sigs, pend)
+                for j, i in enumerate(pend):
+                    bits[i] = pbits[j]
+                return bits  # type: ignore[return-value]
         req = _Req(
             [pub_keys[i] for i in pend],
             [msgs[i] for i in pend],
@@ -131,7 +174,7 @@ class SigBatcher:
                     )
                     self._thread.start()
                 self._cond.notify_all()
-            if not req.event.wait(_RESULT_TIMEOUT_S):
+            if not req.event.wait(self.result_timeout_s):
                 req.bits = [
                     bool(pk.verify_signature(m, s))
                     for pk, m, s in zip(req.pubs, req.msgs, req.sigs)
@@ -139,6 +182,56 @@ class SigBatcher:
         for j, i in enumerate(pend):
             bits[i] = bool(req.bits[j])
         return bits  # type: ignore[return-value]
+
+    # -- engine path ----------------------------------------------------------
+
+    @staticmethod
+    def _engine():
+        """The active continuous-batching engine, or None when the backend
+        chain runs bare (`CMTPU_COALESCE=0`, or a test-installed backend) —
+        the legacy private-window dispatcher serves those."""
+        from cometbft_tpu.sidecar import backend as _be
+        from cometbft_tpu.sidecar import engine as _engine
+
+        try:
+            return _engine.engine_of(_be.get_backend())
+        except Exception:
+            return None
+
+    def _engine_dispatch(self, eng, pub_keys, msgs, sigs, pend) -> list[bool]:
+        """Submit the pending triples consensus-class and wait. Decision
+        path matches the legacy dispatcher bit for bit: only VALID
+        dispatched triples populate the verified cache, and any failure —
+        engine error, chain exhaustion surfacing as an exception, or the
+        deadline-derived timeout — degrades THIS request alone to the
+        scalar anchor."""
+        from cometbft_tpu.crypto import ed25519 as _ed
+        from cometbft_tpu.sidecar.engine import CLASS_CONSENSUS
+
+        pubs = [pub_keys[i].bytes() for i in pend]
+        ms = [bytes(msgs[i]) for i in pend]
+        ss = [bytes(sigs[i]) for i in pend]
+        try:
+            fut = eng.submit(pubs, ms, ss, klass=CLASS_CONSENSUS)
+            _, rbits = fut.result(self.result_timeout_s)
+            rbits = [bool(b) for b in rbits]
+        except Exception:
+            with self._cond:
+                self.fallbacks += 1
+            return [
+                bool(pub_keys[i].verify_signature(msgs[i], sigs[i]))
+                for i in pend
+            ]
+        _ed._verified_put_many(
+            [(p, s, m) for p, m, s, b in zip(pubs, ms, ss, rbits) if b]
+        )
+        with self._cond:
+            self.dispatches += 1
+            self.dispatched_sigs += len(pend)
+            if fut.shared:
+                self.batched += 1
+            self.max_batch = max(self.max_batch, len(pend))
+        return rbits
 
     def close(self) -> None:
         with self._cond:

@@ -54,7 +54,7 @@ _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
 _PERTURB_FULL = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
-    "light_gateway",
+    "light_gateway", "mixed_load",
 )
 _PERTURB_SMALL = ("pause", "restart", "backend_faults", "tx_flood")
 

@@ -64,7 +64,7 @@ ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
-    "light_gateway",
+    "light_gateway", "mixed_load",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -261,6 +261,9 @@ class E2ERunner:
         # vote_batch perturbation's zero-valid-vote-loss probe.
         self._votebatch_armed: set[str] = set()
         self._vote_batches: dict[str, dict] = {}
+        # Per-node results of the mixed_load perturbation (tx flood + light
+        # swarm driven CONCURRENTLY: all engine classes contend at once).
+        self._mixed_loads: dict[str, dict] = {}
         # Stall forensics: every node's consensus round-state, captured at
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
@@ -579,6 +582,47 @@ class E2ERunner:
             time.sleep(1.0)
             self.procs[name] = self._launch(idx)
             self._vote_batches[name] = self._vote_batch_check(name, h0)
+        elif kind == "mixed_load":
+            # All verification classes at once: relaunch with per-sender
+            # rate limiting armed (the tx_flood arming), then drive the
+            # hostile-signer flood AND a light-client bisection swarm
+            # against the same node CONCURRENTLY.  Ingress preverify,
+            # light-client commit verification and the node's own consensus
+            # votes now contend for the one engine queue — QoS holds if the
+            # flood is shed, every honest tx commits within bound, the
+            # swarm agrees, and honest blocks keep landing (heal check
+            # below).
+            self._flood_armed.add(name)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+            h0 = self.wait_height(self.manifest.nodes[0].name, 1)
+            self.wait_height(name, h0 + 1, timeout=420)
+            results: dict[str, dict] = {}
+            errors: list[BaseException] = []
+
+            def _arm(key: str, fn) -> None:
+                try:
+                    results[key] = fn(node)
+                except BaseException as e:  # re-raised on the main thread
+                    errors.append(e)
+
+            flood_t = threading.Thread(
+                target=_arm, args=("tx_flood", self._tx_flood)
+            )
+            swarm_t = threading.Thread(
+                target=_arm, args=("light_swarm", self._light_client_swarm)
+            )
+            flood_t.start()
+            swarm_t.start()
+            flood_t.join(timeout=600)
+            swarm_t.join(timeout=600)
+            if errors:
+                raise errors[0]
+            if flood_t.is_alive() or swarm_t.is_alive():
+                raise AssertionError(f"{name}: mixed_load arm never finished")
+            self._mixed_loads[name] = results
         elif kind == "concurrent_light_clients":
             # No process disruption: the stress IS the perturbation.  N
             # light clients bisect against this node simultaneously; their
@@ -1239,6 +1283,8 @@ class E2ERunner:
                 report["tx_flood"] = self._tx_floods
             if self._vote_batches:
                 report["vote_batch"] = self._vote_batches
+            if self._mixed_loads:
+                report["mixed_load"] = self._mixed_loads
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

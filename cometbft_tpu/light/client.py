@@ -15,6 +15,7 @@ import secrets
 from dataclasses import dataclass
 
 from cometbft_tpu.light import verifier
+from cometbft_tpu.sidecar import engine
 from cometbft_tpu.light.provider import (
     ErrLightBlockNotFound,
     ErrNoResponse,
@@ -294,7 +295,10 @@ class Client:
             if len(bv):
                 self.speculation["descents"] += 1
                 self.speculation["prewarmed_sigs"] += len(bv)
-                bv.verify()  # cache-filters, dedups, populates _verified
+                # Light-class engine admission: speculative descent is
+                # opportunistic prewarm, lowest on the priority ladder.
+                with engine.submission_class(engine.CLASS_LIGHT):
+                    bv.verify()  # cache-filters, dedups, populates _verified
         except Exception:
             pass
 

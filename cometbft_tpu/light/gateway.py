@@ -54,6 +54,7 @@ import os
 import threading
 
 from cometbft_tpu.light import verifier
+from cometbft_tpu.sidecar import engine
 from cometbft_tpu.light.mmr import MMR
 from cometbft_tpu.light.provider import Provider
 from cometbft_tpu.types.light_block import LightBlock
@@ -317,7 +318,11 @@ class LightGateway:
                     continue
             if len(bv):
                 self._bump("prewarmed_sigs", len(bv))
-                bv.verify()
+                # Light-class (lowest) admission into the continuous-
+                # batching engine: prewarm rides spare device capacity and
+                # relies on the starvation hatch for eventual service.
+                with engine.submission_class(engine.CLASS_LIGHT):
+                    bv.verify()
         except Exception:
             pass  # accelerator, never an arbiter
 

@@ -45,6 +45,7 @@ from cometbft_tpu.mempool.clist_mempool import (
     ErrTxInCache,
 )
 from cometbft_tpu.mempool.lanes import LaneFull, LaneItem, LaneSet
+from cometbft_tpu.sidecar import engine
 
 # -- SignedTxEnvelope wire format (version 1) --------------------------------
 #
@@ -316,7 +317,13 @@ class IngressPipeline:
                     ed25519.PubKey(env.pubkey), env.sign_bytes(), env.signature
                 )
             try:
-                _, bits = verifier.verify()
+                # Ingress-class admission into the continuous-batching
+                # engine (round 14): preverify work rides the shared device
+                # queue below consensus votes and blocksync, above light
+                # prewarm. BatchVerifier semantics (cache filter, dedup,
+                # scalar fallback on chain exhaustion) are unchanged.
+                with engine.submission_class(engine.CLASS_INGRESS):
+                    _, bits = verifier.verify()
             except Exception:
                 # Anchor of last resort: scalar-verify each envelope so a
                 # broken backend chain degrades throughput, not correctness.

@@ -188,6 +188,7 @@ class Node:
             reg.gauge_func("blockstore", "base", "Block store base height.",
                            lambda: self.block_store.base())
             self._register_backend_metrics(reg)
+            self._register_engine_metrics(reg)
             self._register_mesh_metrics(reg)
             self._register_hotpath_metrics(reg)
             self._register_lightgw_metrics(reg)
@@ -474,6 +475,75 @@ class Node:
                        "Serving pod chip count from the Ping capability "
                        "reply.",
                        sidecar_sample("remote_mesh_width"))
+
+    @staticmethod
+    def _register_engine_metrics(reg) -> None:
+        """engine_* gauges: the continuous-batching verification engine's
+        per-class view (consensus/blocksync/ingress/light admission counts,
+        dispatched signatures, p95 admission wait, starvation promotions)
+        plus its dispatch total. Lazy like the backend gauges — the sampler
+        peeks `backend_mod._backend` (never get_backend()) and unwraps the
+        CoalescingScheduler shim, so a scrape never constructs the chain;
+        the legacy scheduler_*/vote_batch_* gauges keep reading through
+        their existing registrations. Zeros under CMTPU_COALESCE=0."""
+        from cometbft_tpu.sidecar import backend as backend_mod
+
+        def _engine():
+            from cometbft_tpu.sidecar.engine import engine_of
+
+            return engine_of(backend_mod._backend)
+
+        def eng_sample(fn0):
+            def fn():
+                eng = _engine()
+                if eng is None:
+                    return 0
+                try:
+                    return fn0(eng)
+                except Exception:
+                    return 0
+
+            return fn
+
+        reg.gauge_func(
+            "engine", "dispatches",
+            "Device dispatches the continuous-batching engine issued.",
+            eng_sample(lambda e: e.counters_["dispatches"]),
+        )
+        from cometbft_tpu.sidecar.engine import CLASS_NAMES
+
+        for klass, cname in enumerate(CLASS_NAMES):
+            reg.gauge_func(
+                "engine", f"{cname}_admitted",
+                f"{cname}-class requests admitted to the engine.",
+                eng_sample(
+                    lambda e, k=klass: e.class_counters_[k]["admitted"]
+                ),
+            )
+            reg.gauge_func(
+                "engine", f"{cname}_dispatched_sigs",
+                f"{cname}-class signatures dispatched to the device.",
+                eng_sample(
+                    lambda e, k=klass: e.class_counters_[k]["dispatched_sigs"]
+                ),
+            )
+            reg.gauge_func(
+                "engine", f"{cname}_p95_us",
+                f"{cname}-class 95th-percentile admission wait, microseconds.",
+                eng_sample(
+                    lambda e, k=klass: int(e.class_wait_p95_ms(k) * 1000)
+                ),
+            )
+            reg.gauge_func(
+                "engine", f"{cname}_starvation_promotions",
+                f"{cname}-class requests promoted past fresher "
+                "higher-class work by the starvation hatch.",
+                eng_sample(
+                    lambda e, k=klass: e.class_counters_[k][
+                        "starvation_promotions"
+                    ]
+                ),
+            )
 
     @staticmethod
     def _register_mesh_metrics(reg) -> None:

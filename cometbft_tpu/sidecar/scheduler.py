@@ -1,42 +1,26 @@
-"""Dynamic micro-batching verification scheduler.
+"""Coalescing scheduler — compat shim over the continuous-batching engine.
 
-The supervised chain bounds one call and the hybrid planner splits one
-call, but until this layer every CALLER still dispatched alone: concurrent
-verifications from consensus, blocksync, the light client and RPC each paid
-the full device dispatch latency and serialized on the device-owner thread.
-The lane-parallel kernel is indifferent to which commit a signature belongs
-to, so signatures from many in-flight requests can share one dispatch —
-the same request-coalescing move inference servers make (Orca-style
-continuous batching, Triton-style dynamic batchers).
+Round 6 built `CoalescingScheduler` as the micro-batching front of the
+`CMTPU_BACKEND=auto` chain: concurrent callers' requests merge into one
+columnar dispatch with within-batch triple dedup, per-request bitmap
+slicing, and per-request fallback retries when a merged dispatch fails.
+Round 14 generalized that machinery into the continuous-batching
+verification engine (`sidecar/engine.py`) — priority classes, starvation
+escape, deadline-aware dispatch sizing — and this class became a thin
+shim that embeds one.
 
-`CoalescingScheduler` is the outermost tier of the `CMTPU_BACKEND=auto`
-chain (backend.py wires it above `build_resilient()`'s supervisor):
+The public surface is unchanged: `submit()` returns a `VerifyFuture`,
+`batch_verify` is submit + wait, the knobs keep their names
+(`CMTPU_COALESCE_WINDOW_MS` maps onto the engine's compat hold,
+`CMTPU_COALESCE_MAX` pins the merge cap, `CMTPU_COALESCE=0` still strips
+the layer in backend.py), `counters()` keeps its legacy keys, and
+`refresh_cap()` delegates to the engine so a Ping-advertised wider remote
+mesh still grows the auto merge cap (grow-only; pinned caps never move).
+Everything a caller observed of the round-6 scheduler — dispatch shapes,
+slicing, error isolation — is the engine behaving identically for
+untagged (blocksync-class) traffic under a compat hold.
 
-  callers --submit--> scheduler --ONE batch_verify--> supervisor -> hybrid -> cpu
-
-* Callers block on a future (`batch_verify` is submit + wait, so the
-  `VerifyBackend` surface is unchanged and every existing dispatch site —
-  types/validation commit verification, the blocksync window pre-verify,
-  the light client — coalesces without modification).
-* A single dispatcher thread accumulates requests for a short window
-  (`CMTPU_COALESCE_WINDOW_MS`, default 2 ms) or until the batch reaches
-  `CMTPU_COALESCE_MAX` signatures, packs them into one columnar batch with
-  within-batch triple dedup (N light clients bisecting the same chain
-  submit identical triples — they share lanes), issues ONE `batch_verify`
-  through the chain, and slices the returned bitmap back per request.
-* Requests queued while a dispatch is in flight coalesce into the next
-  dispatch (continuous batching): a burst's first request pays at most the
-  window, the rest pay nothing.
-* A failed coalesced dispatch falls back to per-request retries, so one
-  poisoned request (oversized sig that makes a tier raise, a wedge that
-  outlives the chain) cannot fail its batchmates; only the guilty
-  request's caller sees the error.
-
-Single requests larger than `CMTPU_COALESCE_MAX` are never split — the
-hybrid planner owns WITHIN-call splitting; this layer only merges ACROSS
-callers, and the supervisor between them bounds whatever is dispatched.
-
-The sidecar SERVER embeds the same scheduler over its device lock
+The sidecar SERVER embeds the same shim over its device lock
 (sidecar/service.py, round 10): there the concurrent submitters are
 CONNECTIONS — many node processes sharing one tunnel — and streamed
 chunks, so cross-process requests merge into one columnar dispatch with
@@ -46,88 +30,14 @@ the identical slicing/fallback discipline.
 from __future__ import annotations
 
 import os
-import sys
-import threading
-import time
 
 from cometbft_tpu.sidecar.backend import VerifyBackend
-
-_WAIT_SAMPLES = 512  # queue-wait ring buffer (p50/p95 source)
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _mesh_width_for_cap() -> int:
-    """Device count behind the default dispatch cap (16384 x width), read
-    WITHOUT risking a device-tunnel probe from this constructor: use the
-    kernel's already-probed width when available (the auto chain constructs
-    its device tier — which probes — before this layer), and only probe
-    ourselves when JAX is pinned to the local CPU backend with a forced
-    virtual device count (the test/dryrun mesh). Everywhere else the probe
-    could hang a node start behind a wedged axon tunnel, and a cpu-only
-    deployment shouldn't pay a jax import for a cap it can't use."""
-    ek = sys.modules.get("cometbft_tpu.ops.ed25519_kernel")
-    if ek is not None and ek.known_mesh_width():
-        return ek.known_mesh_width()
-    if (
-        os.environ.get("JAX_PLATFORMS", "") == "cpu"
-        and "xla_force_host_platform_device_count"
-        in os.environ.get("XLA_FLAGS", "")
-    ):
-        try:
-            from cometbft_tpu.ops import ed25519_kernel as ek2
-
-            return ek2.mesh_width()
-        except Exception:
-            return 1
-    return 1
-
-
-class VerifyFuture:
-    """Result slot a submitter blocks on; filled by the dispatcher."""
-
-    __slots__ = ("_event", "_result", "_error", "t_submit", "n_sigs")
-
-    def __init__(self, n_sigs: int):
-        self._event = threading.Event()
-        self._result: tuple[bool, list[bool]] | None = None
-        self._error: BaseException | None = None
-        self.t_submit = time.perf_counter()
-        self.n_sigs = n_sigs
-
-    def _set_result(self, result: tuple[bool, list[bool]]) -> None:
-        self._result = result
-        self._event.set()
-
-    def _set_error(self, err: BaseException) -> None:
-        self._error = err
-        self._event.set()
-
-    def done(self) -> bool:
-        return self._event.is_set()
-
-    def result(self, timeout: float | None = None) -> tuple[bool, list[bool]]:
-        if not self._event.wait(timeout):
-            raise TimeoutError("verification future not resolved in time")
-        if self._error is not None:
-            raise self._error
-        return self._result
-
-
-class _Request:
-    __slots__ = ("pubs", "msgs", "sigs", "future", "t_start")
-
-    def __init__(self, pubs, msgs, sigs, future):
-        self.pubs = pubs
-        self.msgs = msgs
-        self.sigs = sigs
-        self.future = future
-        self.t_start = 0.0  # set when the dispatcher picks it up
+from cometbft_tpu.sidecar.engine import (  # noqa: F401  (re-exports)
+    VerificationEngine,
+    VerifyFuture,
+    _env_float,
+    _mesh_width_for_cap,
+)
 
 
 class CoalescingScheduler(VerifyBackend):
@@ -141,306 +51,71 @@ class CoalescingScheduler(VerifyBackend):
         window_ms: float | None = None,
         max_sigs: int | None = None,
     ):
-        self.inner = inner
-        self.window_ms = (
-            _env_float("CMTPU_COALESCE_WINDOW_MS", 2.0)
-            if window_ms is None
-            else window_ms
+        if window_ms is None:
+            window_ms = _env_float("CMTPU_COALESCE_WINDOW_MS", 2.0)
+        if max_sigs is None and os.environ.get("CMTPU_COALESCE_MAX", ""):
+            max_sigs = int(_env_float("CMTPU_COALESCE_MAX", 16384))
+        # max_sigs None -> the engine derives its pod-width auto cap
+        # (16384 x mesh width, grow-only via refresh_cap).
+        self.engine = VerificationEngine(
+            inner, hold_ms=window_ms, max_sigs=max_sigs
         )
-        self._cap_auto = False
-        if max_sigs is not None:
-            self.max_sigs = max_sigs
-        elif os.environ.get("CMTPU_COALESCE_MAX", ""):
-            self.max_sigs = int(_env_float("CMTPU_COALESCE_MAX", 16384))
-        else:
-            # Pod-width default: one merged dispatch can fill every chip
-            # (16384 lanes each — the single-chip cap this generalizes).
-            # An explicit env or arg always wins. The auto cap re-reads the
-            # chain's width periodically (refresh_cap) because the width a
-            # grpc tier serves is only learned from the sidecar's Ping
-            # capability reply AFTER the first connect.
-            self._cap_auto = True
-            self.max_sigs = 16384 * max(1, _mesh_width_for_cap())
-        self._queue: list[_Request] = []
-        self._cond = threading.Condition()
-        self._closed = False
-        self._thread: threading.Thread | None = None
-        self._wait_ms: list[float] = []  # ring buffer of queue waits
-        self._wait_i = 0
-        self.counters_ = {
-            "requests": 0,
-            "dispatches": 0,
-            "coalesced_dispatches": 0,  # dispatches carrying >1 request
-            "batched_requests": 0,      # requests that shared a dispatch
-            "coalesced_sigs": 0,        # sigs that rode a shared dispatch
-            "dedup_sigs": 0,            # lanes saved by within-batch dedup
-            "fallback_splits": 0,       # coalesced dispatches split on error
-        }
 
-    # -- submission surface ------------------------------------------------
+    # -- engine views (no local copies: refresh_cap must never leave a
+    # stale cap behind on the shim) ---------------------------------------
+
+    @property
+    def inner(self) -> VerifyBackend:
+        return self.engine.inner
+
+    @property
+    def window_ms(self) -> float:
+        return self.engine.hold_ms
+
+    @window_ms.setter
+    def window_ms(self, v: float) -> None:
+        self.engine.hold_ms = v
+
+    @property
+    def max_sigs(self) -> int:
+        return self.engine.max_sigs
+
+    @max_sigs.setter
+    def max_sigs(self, v: int) -> None:
+        self.engine.max_sigs = v
+
+    @property
+    def counters_(self) -> dict:
+        return self.engine.counters_
+
+    # -- delegated surface -------------------------------------------------
 
     def submit(self, pubs, msgs, sigs) -> VerifyFuture:
-        """Enqueue one verification request; returns the future its caller
-        blocks on.  Raises after close() — a scheduler with no dispatcher
-        must fail loudly, not hang the submitter forever."""
-        fut = VerifyFuture(len(pubs))
-        if not pubs:
-            fut._set_result((False, []))
-            return fut
-        req = _Request(list(pubs), list(msgs), list(sigs), fut)
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("scheduler is closed")
-            self.counters_["requests"] += 1
-            self._queue.append(req)
-            self._ensure_thread()
-            self._cond.notify_all()
-        return fut
+        return self.engine.submit(pubs, msgs, sigs)
 
     def batch_verify(self, pubs, msgs, sigs):
-        return self.submit(pubs, msgs, sigs).result()
+        return self.engine.batch_verify(pubs, msgs, sigs)
 
     def aggregate_verify(self, pubs, msgs, agg_sig):
-        # One boolean per whole commit: nothing to slice across callers;
-        # pass straight through to the supervised chain.
-        return self.inner.aggregate_verify(pubs, msgs, agg_sig)
+        return self.engine.aggregate_verify(pubs, msgs, agg_sig)
 
     def merkle_root(self, leaves):
-        # Roots carry no cross-caller coalescing opportunity (one tree per
-        # call); pass straight through to the chain.
-        return self.inner.merkle_root(leaves)
+        return self.engine.merkle_root(leaves)
 
     def mesh_width(self) -> int:
-        mw = getattr(self.inner, "mesh_width", None)
-        return int(mw()) if mw is not None else 1
+        return self.engine.mesh_width()
 
     def refresh_cap(self) -> int:
-        """Re-derive the auto merge cap from the chain's CURRENT width
-        (local chips, or a remote pod's once the sidecar Ping capability
-        reply has been seen). Pinned caps (arg/env) never move."""
-        if self._cap_auto:
-            try:
-                width = max(1, self.mesh_width())
-            except Exception:
-                return self.max_sigs
-            new_cap = 16384 * width
-            if new_cap > self.max_sigs:
-                self.max_sigs = new_cap
-        return self.max_sigs
+        return self.engine.refresh_cap()
 
     def ping(self):
-        inner_ping = getattr(self.inner, "ping", None)
-        return inner_ping() if inner_ping is not None else True
-
-    # -- dispatcher --------------------------------------------------------
-
-    def _ensure_thread(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="verify-coalescer"
-            )
-            self._thread.start()
-
-    def _collect(self) -> list[_Request]:
-        """Block until work exists, hold the window open for batchmates,
-        then drain whole requests up to max_sigs (never splitting one)."""
-        with self._cond:
-            while not self._queue and not self._closed:
-                self._cond.wait()
-            if not self._queue:
-                return []
-            window_s = self.window_ms / 1000.0
-            first_t = self._queue[0].future.t_submit
-            while window_s > 0 and not self._closed:
-                if sum(len(r.pubs) for r in self._queue) >= self.max_sigs:
-                    break
-                remaining = first_t + window_s - time.perf_counter()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
-            batch = []
-            total = 0
-            while self._queue:
-                n = len(self._queue[0].pubs)
-                if batch and total + n > self.max_sigs:
-                    break
-                req = self._queue.pop(0)
-                total += n
-                batch.append(req)
-            return batch
-
-    def _loop(self) -> None:
-        while True:
-            batch = self._collect()
-            if not batch:
-                return  # closed and drained
-            now = time.perf_counter()
-            for req in batch:
-                req.t_start = now
-                self._record_wait((now - req.future.t_submit) * 1000.0)
-            try:
-                self._dispatch(batch)
-            except BaseException as e:  # never kill the dispatcher
-                for req in batch:
-                    if not req.future.done():
-                        req.future._set_error(e)
-
-    def _dispatch(self, batch: list[_Request]) -> None:
-        with self._cond:
-            self.counters_["dispatches"] += 1
-            refresh = self._cap_auto and self.counters_["dispatches"] % 64 == 1
-        if refresh:
-            # Cheap cached-width read (no dial): pick up a remote pod's
-            # width once the grpc tier has seen a Ping capability reply.
-            try:
-                self.refresh_cap()
-            except Exception:
-                pass
-        with self._cond:
-            if len(batch) > 1:
-                self.counters_["coalesced_dispatches"] += 1
-                self.counters_["batched_requests"] += len(batch)
-                self.counters_["coalesced_sigs"] += sum(
-                    len(r.pubs) for r in batch
-                )
-        if len(batch) == 1:
-            # Nothing to slice or protect: serve the lone request directly
-            # (errors propagate to its caller alone).
-            req = batch[0]
-            try:
-                req.future._set_result(
-                    self.inner.batch_verify(req.pubs, req.msgs, req.sigs)
-                )
-            except BaseException as e:
-                req.future._set_error(e)
-            return
-        # Columnar pack with within-batch dedup: identical triples from
-        # concurrent requests (N light clients walking the same descent)
-        # share one lane.
-        lane_of: dict[tuple, int] = {}
-        pubs: list[bytes] = []
-        msgs: list[bytes] = []
-        sigs: list[bytes] = []
-        lanes: list[list[int]] = []
-        for req in batch:
-            req_lanes = []
-            for p, m, s in zip(req.pubs, req.msgs, req.sigs):
-                key = (p, s, m)
-                lane = lane_of.get(key)
-                if lane is None:
-                    lane = len(pubs)
-                    lane_of[key] = lane
-                    pubs.append(p)
-                    msgs.append(m)
-                    sigs.append(s)
-                req_lanes.append(lane)
-            lanes.append(req_lanes)
-        dedup = sum(len(r.pubs) for r in batch) - len(pubs)
-        if dedup:
-            with self._cond:
-                self.counters_["dedup_sigs"] += dedup
-        try:
-            _, bits = self.inner.batch_verify(pubs, msgs, sigs)
-        except BaseException:
-            self._fallback(batch)
-            return
-        if len(bits) != len(pubs):
-            # A sick tier answering with the wrong shape is a failed
-            # dispatch, not something to mis-slice.
-            self._fallback(batch)
-            return
-        for req, req_lanes in zip(batch, lanes):
-            req_bits = [bits[lane] for lane in req_lanes]
-            req.future._set_result((all(req_bits), req_bits))
-
-    def _fallback(self, batch: list[_Request]) -> None:
-        """The coalesced dispatch failed: retry each request alone so one
-        poisoned request cannot fail its batchmates.  Per-request errors go
-        to that request's caller only."""
-        with self._cond:
-            self.counters_["fallback_splits"] += 1
-        for req in batch:
-            try:
-                req.future._set_result(
-                    self.inner.batch_verify(req.pubs, req.msgs, req.sigs)
-                )
-            except BaseException as e:
-                req.future._set_error(e)
-
-    # -- observability -----------------------------------------------------
-
-    def _record_wait(self, ms: float) -> None:
-        with self._cond:
-            if len(self._wait_ms) < _WAIT_SAMPLES:
-                self._wait_ms.append(ms)
-            else:
-                self._wait_ms[self._wait_i % _WAIT_SAMPLES] = ms
-            self._wait_i += 1
-
-    def _wait_percentile(self, q: float) -> float:
-        with self._cond:
-            if not self._wait_ms:
-                return 0.0
-            data = sorted(self._wait_ms)
-        idx = min(len(data) - 1, int(q * (len(data) - 1) + 0.5))
-        return data[idx]
+        return self.engine.ping()
 
     def counters(self) -> dict:
-        with self._cond:
-            out = dict(self.counters_)
-            out["queue_depth"] = len(self._queue)
-        out["max_sigs"] = self.max_sigs
-        d = max(1, out["dispatches"])
-        out["coalesce_ratio"] = round(out["requests"] / d, 3)
-        out["queue_wait_p50_ms"] = round(self._wait_percentile(0.50), 3)
-        out["queue_wait_p95_ms"] = round(self._wait_percentile(0.95), 3)
-        inner_counters = getattr(self.inner, "counters", None)
-        if inner_counters is not None:
-            out["inner"] = inner_counters()
-        return out
+        return self.engine.counters()
 
     def register_metrics(self, registry) -> None:
-        """scheduler_* gauges on a libs.metrics Registry; the inner chain
-        registers its own backend_* gauges (node/node.py wires both)."""
-        registry.gauge_func(
-            "scheduler", "requests", "Verification requests submitted.",
-            lambda: self.counters_["requests"],
-        )
-        registry.gauge_func(
-            "scheduler", "dispatches", "Backend dispatches issued.",
-            lambda: self.counters_["dispatches"],
-        )
-        registry.gauge_func(
-            "scheduler", "batched_requests",
-            "Requests that shared a coalesced dispatch.",
-            lambda: self.counters_["batched_requests"],
-        )
-        registry.gauge_func(
-            "scheduler", "fallback_splits",
-            "Coalesced dispatches split into per-request retries.",
-            lambda: self.counters_["fallback_splits"],
-        )
-        registry.gauge_func(
-            "scheduler", "coalesce_ratio_milli",
-            "Requests per dispatch x1000.",
-            lambda: int(
-                1000 * self.counters_["requests"]
-                / max(1, self.counters_["dispatches"])
-            ),
-        )
-        registry.gauge_func(
-            "scheduler", "queue_wait_p95_us",
-            "95th-percentile queue wait, microseconds.",
-            lambda: int(self._wait_percentile(0.95) * 1000),
-        )
+        self.engine.register_metrics(registry)
 
     def close(self) -> None:
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        thread = self._thread
-        if thread is not None and thread.is_alive():
-            thread.join(timeout=5.0)
-        inner_close = getattr(self.inner, "close", None)
-        if inner_close is not None:
-            inner_close()
+        self.engine.close()

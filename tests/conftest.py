@@ -102,6 +102,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "engine: continuous-batching verification-engine tests (priority "
+        "classes, starvation escape, deadline-aware dispatch sizing, "
+        "mixed-load starvation-freedom property, scheduler-shim compat); "
+        "runs in tier-1 — `-m engine` selects just this group",
+    )
+    config.addinivalue_line(
+        "markers",
         "agg: aggregate BLS commit tests (BN254 aggregate wire form, "
         "three-mode verify bit-parity, poisoned-aggregate rejection, "
         "device multi-pairing kernel); fast paths run in tier-1, the "

@@ -70,7 +70,8 @@ def test_full_profile_reaches_every_dimension():
     for kt in ("ed25519", "secp256k1", "sr25519", "bn254"):
         assert any(n["key_type"] == kt for n in nodes), kt
     for p in ("kill", "pause", "disconnect", "restart", "backend_faults",
-              "concurrent_light_clients", "tx_flood", "vote_batch"):
+              "concurrent_light_clients", "tx_flood", "vote_batch",
+              "light_gateway", "mixed_load"):
         assert any(p in n["perturb"] for n in nodes), p
 
 
