@@ -516,14 +516,10 @@ def mesh_worker() -> None:
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache")
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:
-        plog(f"cache config failed: {e}")
+    from cometbft_tpu.ops import xla_cache
+
+    if not xla_cache.enable_persistent_cache(HERE):
+        plog("cache config failed (jaxlib lacks the persistent-cache knobs)")
     print("MESH_JSON " + json.dumps(_mesh_stage_inner(plog)), flush=True)
 
 
@@ -579,12 +575,10 @@ def tpu_worker() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    try:
-        jax.config.update("jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:
-        plog(f"cache config failed: {e}")
+    from cometbft_tpu.ops import xla_cache
+
+    if not xla_cache.enable_persistent_cache(HERE):
+        plog("cache config failed (jaxlib lacks the persistent-cache knobs)")
     devs = jax.devices()
     plog(f"devices: {devs} platform={devs[0].platform}")
     if "--probe-only" in sys.argv:
@@ -1853,14 +1847,10 @@ def agg_worker() -> None:
     plog(f"start; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
     import jax
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache")
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:
-        plog(f"cache config failed: {e}")
+    from cometbft_tpu.ops import xla_cache
+
+    if not xla_cache.enable_persistent_cache(HERE):
+        plog("cache config failed (jaxlib lacks the persistent-cache knobs)")
     os.environ["CMTPU_BN254_DEVICE"] = "1"
     from cometbft_tpu.crypto import bn254 as b
     from cometbft_tpu.ops import bn254_kernel as bk
@@ -2334,6 +2324,159 @@ def _sidecar_stage(stages: dict, plog) -> None:
     )
 
 
+def _fanout_stage(stages: dict, plog) -> None:
+    """Multi-host fan-out (ISSUE 15): one batch split into width-weighted
+    slices across N sidecar shards, each behind its own latency relay and
+    a simulated rate-model device (fixed dispatch cost + n/rate ms, real
+    CPU bits). Three arms: 1 shard (everything serial through one host),
+    N shards (slices dispatched concurrently — the fleet), and N shards
+    with one WEDGED (its slice must time out and redistribute across the
+    survivors, completing with redistribution counter > 0). All simulated
+    costs are labeled; every arm's bitmap is asserted bit-identical to the
+    in-process CPU backend."""
+    from cometbft_tpu.sidecar.backend import CpuBackend
+    from cometbft_tpu.sidecar.fanout import FanoutBackend
+    from cometbft_tpu.sidecar.service import GrpcBackend, SidecarServer
+
+    n = int(os.environ.get("CMTPU_BENCH_FANOUT_SIGS", "2048"))
+    n_shards = int(os.environ.get("CMTPU_BENCH_FANOUT_SHARDS", "4"))
+    rate = float(os.environ.get("CMTPU_BENCH_FANOUT_RATE", "2.0"))
+    dispatch_ms = float(os.environ.get("CMTPU_BENCH_FANOUT_DISPATCH_MS", "5"))
+    rtt_ms = float(os.environ.get("CMTPU_BENCH_FANOUT_RTT_MS", "20"))
+    # Wide enough that the serial 1-shard arm (the whole batch through one
+    # host, plus real CPU verification) never trips it — only the wedged
+    # shard's slice should time out.
+    deadline_ms = float(
+        os.environ.get("CMTPU_BENCH_FANOUT_DEADLINE_MS", "4000")
+    )
+
+    _, pubs, msgs, sigs = _signed_batch(n, tag=b"fanout")
+    for i in (1, n // 3, n - 5):  # non-trivial bitmap
+        sigs[i] = sigs[i][:-1] + bytes([sigs[i][-1] ^ 1])
+    cpu = CpuBackend()
+    expect_ok, expect_bits = cpu.batch_verify(pubs, msgs, sigs)
+    # The shard servers answer from this table (real bits, computed ONCE by
+    # the CPU backend above) instead of re-running crypto: all N "shards"
+    # live in this one process, so real verification would serialize on the
+    # GIL and dilute the dispatch-orchestration speedup this stage measures.
+    # Slicing/reassembly correctness is still exercised for real — a
+    # misplaced slice boundary scrambles which lanes carry the flipped bits.
+    table = {
+        (p, m, s): b for p, m, s, b in zip(pubs, msgs, sigs, expect_bits)
+    }
+
+    wedge_s = deadline_ms * 3 / 1000.0
+
+    class _RateModel:
+        """Simulated per-shard device: fixed dispatch cost + n/rate ms,
+        bits from the precomputed table — shard walls scale with slice
+        size, so splitting the batch is what buys the speedup."""
+
+        name = "ratemodel"
+
+        def __init__(self):
+            self.wedged = False
+
+        def batch_verify(self, pubs_, msgs_, sigs_):
+            if self.wedged:
+                time.sleep(wedge_s)
+            time.sleep((dispatch_ms + len(pubs_) / rate) / 1000.0)
+            bits = [
+                table.get((p, m, s), False)
+                for p, m, s in zip(pubs_, msgs_, sigs_)
+            ]
+            return all(bits), bits
+
+        def merkle_root(self, leaves):
+            return cpu.merkle_root(leaves)
+
+    # Inline dispatch on the shard servers (no coalescer): the wedge sleep
+    # must live in a disposable handler thread, not a dispatcher the
+    # server shutdown would wait on.
+    old_coalesce = os.environ.get("CMTPU_COALESCE")
+    os.environ["CMTPU_COALESCE"] = "0"
+    servers: list = []
+    relays: list = []
+    backends: list = []
+    try:
+        for _ in range(n_shards):
+            backend = _RateModel()
+            backends.append(backend)
+            srv = SidecarServer("127.0.0.1:0", backend=backend).start()
+            servers.append(srv)
+            relays.append(
+                _LatencyRelay(
+                    "127.0.0.1",
+                    srv._server.server_address[1],
+                    rtt_ms / 2000.0,
+                )
+            )
+
+        def run_arm(k: int):
+            fan = FanoutBackend(
+                [
+                    (f"shard{i}", GrpcBackend(relays[i].addr, timeout_s=120))
+                    for i in range(k)
+                ],
+                deadline_ms=deadline_ms,
+            )
+            try:
+                t0 = time.perf_counter()
+                ok, bits = fan.batch_verify(pubs, msgs, sigs)
+                wall = (time.perf_counter() - t0) * 1000
+                return wall, ok, bits, fan.counters()
+            finally:
+                fan.close()
+
+        one_ms, ok1, bits1, _ = run_arm(1)
+        n_ms, okn, bitsn, cn = run_arm(n_shards)
+        backends[-1].wedged = True  # one sick host for the last arm
+        wedged_ms, okw, bitsw, cw = run_arm(n_shards)
+
+        bit_identical = (
+            bits1 == expect_bits
+            and bitsn == expect_bits
+            and bitsw == expect_bits
+            and ok1 == okn == okw == expect_ok
+        )
+        if not bit_identical:  # pragma: no cover - acceptance guard
+            raise AssertionError("fanout bitmaps diverged from CPU backend")
+        if cw["redistributions"] < 1:  # pragma: no cover - acceptance guard
+            raise AssertionError("wedged-shard arm never redistributed")
+    finally:
+        if old_coalesce is None:
+            os.environ.pop("CMTPU_COALESCE", None)
+        else:
+            os.environ["CMTPU_COALESCE"] = old_coalesce
+        for r in relays:
+            r.close()
+        for s in servers:
+            s.shutdown()
+
+    stages["fanout"] = {
+        "sigs": n,
+        "shards": n_shards,
+        "shard_widths": {k: v["width"] for k, v in cn["shards"].items()},
+        "simulated_rate_sigs_per_ms": rate,
+        "simulated_dispatch_ms": dispatch_ms,
+        "simulated_rtt_ms": rtt_ms,
+        "deadline_ms": deadline_ms,
+        "one_shard_ms": round(one_ms, 2),
+        "n_shard_ms": round(n_ms, 2),
+        "speedup": round(one_ms / max(n_ms, 1e-9), 2),
+        "wedged_ms": round(wedged_ms, 2),
+        "redistributions": cw["redistributions"],
+        "redistributed_sigs": cw["redistributed_sigs"],
+        "bitmap_identical": bit_identical,
+    }
+    plog(
+        f"fanout: {n} sigs @ rate {rate}/ms, rtt {rtt_ms} ms: "
+        f"1 shard {one_ms:.0f} ms -> {n_shards} shards {n_ms:.0f} ms "
+        f"({stages['fanout']['speedup']}x); wedged arm {wedged_ms:.0f} ms, "
+        f"{cw['redistributions']} redistribution(s)"
+    )
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -2452,6 +2595,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _engine_stage(stages, plog)
         except Exception as e:
             plog(f"engine stage failed: {type(e).__name__}: {e}")
+
+    # ---- multi-host fan-out: 1 shard vs N shards vs N-with-one-wedged ----
+    if budget_left():
+        try:
+            _fanout_stage(stages, plog)
+        except Exception as e:
+            plog(f"fanout stage failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":

@@ -78,17 +78,27 @@ class PeerState:
                 return
             self.proposal_pol_round = msg.proposal_pol_round
 
-    def mark_part_sent(self, height: int, index: int) -> bool:
+    def mark_part_sent(self, height: int, round: int, index: int) -> bool:
+        """The round is part of the key: each round proposes a DIFFERENT
+        block, so "peer has part (h, idx)" is only meaningful per round.
+        Keying on (height, index) alone let a STALE part — one relayed
+        rounds late during a livelock — mark the peer as having the
+        CURRENT round's part, silently suppressing part gossip for every
+        later round of the height (the e2e matrix height-5/7 stall: the
+        proposal and votes, whose keys carry the round, kept flowing while
+        the one block part starved round after round).  Catchup parts of a
+        committed block pass round=-1 (unique per height, no round
+        needed)."""
         with self._mtx:
-            key = (height, index)
+            key = (height, round, index)
             if key in self._sent_parts:
                 return False
             self._sent_parts.add(key)
             return True
 
-    def unmark_part_sent(self, height: int, index: int) -> None:
+    def unmark_part_sent(self, height: int, round: int, index: int) -> None:
         with self._mtx:
-            self._sent_parts.discard((height, index))
+            self._sent_parts.discard((height, round, index))
 
     def mark_vote_sent(self, key) -> bool:
         with self._mtx:
@@ -205,7 +215,7 @@ class ConsensusReactor(Reactor):
                     ps.apply_proposal_pol(msg)
                     return  # peer-state only; not a state-machine input
                 elif isinstance(msg, cmsg.BlockPartMessage):
-                    ps.mark_part_sent(msg.height, msg.part.index)
+                    ps.mark_part_sent(msg.height, msg.round, msg.part.index)
                 elif isinstance(msg, cmsg.VoteMessage):
                     v = msg.vote
                     ps.mark_vote_sent(
@@ -376,7 +386,7 @@ class ConsensusReactor(Reactor):
             return False
         sent = False
         for i in range(block_meta.block_id.part_set_header.total):
-            if ps.mark_part_sent(ps.height, i):
+            if ps.mark_part_sent(ps.height, -1, i):
                 part = self.cs.block_store.load_block_part(ps.height, i)
                 # A full send queue drops the message: un-mark so the
                 # next gossip pass retries instead of losing the part
@@ -389,7 +399,7 @@ class ConsensusReactor(Reactor):
                 ):
                     sent = True
                 else:
-                    ps.unmark_part_sent(ps.height, i)
+                    ps.unmark_part_sent(ps.height, -1, i)
         seen_commit = self.cs.block_store.load_seen_commit(ps.height)
         if seen_commit is not None:
             from cometbft_tpu.types.vote import Vote
@@ -455,7 +465,7 @@ class ConsensusReactor(Reactor):
         if rs.proposal_block_parts is not None:
             for i in range(rs.proposal_block_parts.total):
                 part = rs.proposal_block_parts.get_part(i)
-                if part is not None and ps.mark_part_sent(rs.height, i):
+                if part is not None and ps.mark_part_sent(rs.height, rs.round, i):
                     if ps.peer.try_send(
                         CONSENSUS_DATA_CHANNEL,
                         cmsg.encode_consensus_message(
@@ -464,7 +474,7 @@ class ConsensusReactor(Reactor):
                     ):
                         sent = True
                     else:
-                        ps.unmark_part_sent(rs.height, i)
+                        ps.unmark_part_sent(rs.height, rs.round, i)
         return sent
 
     def _gossip_votes(self, ps: PeerState, rs) -> bool:

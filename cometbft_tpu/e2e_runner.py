@@ -715,25 +715,37 @@ class E2ERunner:
         pub = ed25519.gen_priv_key().pub_key()
         b64 = base64.b64encode(pub.bytes()).decode()
 
-        def tx_and_settle(power: int) -> None:
+        def set_size() -> int:
+            return len(cli.call("validators")["validators"])
+
+        def tx_and_settle(power: int, want_size: int) -> None:
+            """Broadcast the update and poll the validator set until it
+            reflects it.  Waiting a fixed two heights is NOT enough: under
+            a concurrent tx flood the churn tx can land several blocks
+            after broadcast, so a height-anchored query races the update
+            (observed as "4 -> 5" when the add activates only after the
+            post-add query, between the two reads)."""
             tx = f"val:{b64}!{power}".encode()
             res = cli.call("broadcast_tx_sync", tx="0x" + tx.hex())
             if int(res.get("code", 0)) != 0:
                 raise AssertionError(f"churn tx rejected: {res}")
-            h = self._height(first)
-            self.wait_height(first, h + 2)  # update lands at +1, active at +2
+            deadline = time.time() + 60
+            n = set_size()
+            while n != want_size and time.time() < deadline:
+                time.sleep(0.25)
+                n = set_size()
+            if n != want_size:
+                raise AssertionError(
+                    f"validator set stuck at {n} (wanted {want_size}) after "
+                    f"power={power} update"
+                )
 
+        base = set_size()
         self.log(f"churn: adding validator {pub.address().hex()[:12]}…")
-        tx_and_settle(1)
-        n_now = len(cli.call("validators")["validators"])
+        tx_and_settle(1, base + 1)
         self.log("churn: removing it again")
-        tx_and_settle(0)
-        n_after = len(cli.call("validators")["validators"])
-        if not (n_now == n_after + 1):
-            raise AssertionError(
-                f"validator churn did not round-trip: {n_now} -> {n_after}"
-            )
-        return {"added_then_removed": b64, "set_size": n_after}
+        tx_and_settle(0, base)
+        return {"added_then_removed": b64, "set_size": base}
 
     # -- light client (runner/test.go + light package) --------------------
 

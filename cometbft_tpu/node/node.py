@@ -190,6 +190,7 @@ class Node:
             self._register_backend_metrics(reg)
             self._register_engine_metrics(reg)
             self._register_mesh_metrics(reg)
+            self._register_fanout_metrics(reg)
             self._register_hotpath_metrics(reg)
             self._register_lightgw_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
@@ -578,6 +579,70 @@ class Node:
                        "Fused merkle roots served by the subtree-parallel "
                        "mesh program.",
                        mesh_sample("merkle_sharded_dispatches"))
+
+    @staticmethod
+    def _register_fanout_metrics(reg) -> None:
+        """fanout_* gauges: the multi-host verification fleet (shard count,
+        combined width, dispatches, redistributions, shards cooling down).
+        Lazy like the backend gauges — the sampler walks the ALREADY-BUILT
+        chain under `backend_mod._backend` for a tier named `fanout` (never
+        get_backend(), never a dial), so a scrape with no fleet configured
+        costs a few getattr probes and reads zero."""
+        from cometbft_tpu.sidecar import backend as backend_mod
+
+        def _fanout():
+            stack, seen = [backend_mod._backend], set()
+            while stack:
+                b = stack.pop()
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if getattr(b, "name", "") == "fanout":
+                    return b
+                stack.append(getattr(b, "inner", None))
+                for t in getattr(b, "tiers", ()) or ():
+                    stack.append(getattr(t, "backend", None))
+            return None
+
+        def fan_sample(fn0):
+            def fn():
+                fan = _fanout()
+                if fan is None:
+                    return 0
+                try:
+                    return fn0(fan)
+                except Exception:
+                    return 0
+
+            return fn
+
+        import time as _time
+
+        reg.gauge_func("fanout", "shards",
+                       "Shards in the verification fleet (0 = no fleet).",
+                       fan_sample(lambda f: len(f.shards)))
+        reg.gauge_func("fanout", "width",
+                       "Combined fleet width (sum of shard mesh widths).",
+                       fan_sample(lambda f: f.mesh_width()))
+        reg.gauge_func("fanout", "dispatches",
+                       "Batches the fleet fanned out across its shards.",
+                       fan_sample(lambda f: f.counters_["dispatches"]))
+        reg.gauge_func("fanout", "shard_failures",
+                       "Per-shard slice failures (error or deadline).",
+                       fan_sample(lambda f: f.counters_["shard_failures"]))
+        reg.gauge_func("fanout", "redistributions",
+                       "Retry rounds that re-split dead shards' slices "
+                       "across survivors.",
+                       fan_sample(lambda f: f.counters_["redistributions"]))
+        reg.gauge_func("fanout", "redistributed_sigs",
+                       "Signatures re-dispatched by redistribution rounds.",
+                       fan_sample(lambda f: f.counters_["redistributed_sigs"]))
+        reg.gauge_func("fanout", "shards_down",
+                       "Shards currently sitting out a failure cooldown.",
+                       fan_sample(lambda f: sum(
+                           1 for s in f.shards
+                           if not s.healthy(_time.monotonic())
+                       )))
 
     def _register_hotpath_metrics(self, reg) -> None:
         """Consensus hot-path gauges: the vote-admission micro-batcher, WAL

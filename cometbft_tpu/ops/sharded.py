@@ -58,6 +58,21 @@ def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     )
 
 
+def sharded_verify_replicated_fn(mesh: Mesh, axis: str = "sig"):
+    """Batch verify with the ok bitmap REPLICATED instead of batch-sharded:
+    on a multi-HOST mesh, `sharded_verify_fn`'s sharded output leaves each
+    host holding only its addressable slice — but the fanout-serving seam
+    (ops/multihost.py) needs the LEADER process to read the whole bitmap
+    locally to answer the sidecar client. The replication all-gather is
+    inserted by GSPMD from the out_sharding, same as the commit step's
+    all-valid bit."""
+    return jax.jit(
+        ek.verify_core,
+        in_shardings=tuple(NamedSharding(mesh, s) for s in _verify_specs(axis)),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
 def _local_tree_root(leaves):
     """Reduce uint32[8, m] leaf digests (m a power of two) to one root [8, 1]
     with level-synchronous pairing."""

@@ -1,0 +1,41 @@
+"""One definition of the repo's persistent XLA compile-cache setup.
+
+The 8-device virtual-mesh programs (sharded verify, the two-process
+multihost commit step, the bn254 aggregate kernel) cost tens of seconds
+to compile on XLA:CPU; pointing every jax-using entry point — conftest,
+bench subprocess workers, the multihost/fanout shard workers — at the
+same `.jax_cache` directory under the repo root means each program
+compiles once per machine, not once per process. This used to be the
+same five lines copy-pasted into each of those files; a helper keeps the
+next worker script from drifting (e.g. forgetting the min-size knobs and
+silently caching nothing).
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def cache_dir(repo_root: str | None = None) -> str:
+    return os.path.join(repo_root or _REPO_ROOT, ".jax_cache")
+
+
+def enable_persistent_cache(repo_root: str | None = None) -> bool:
+    """Point this process's JAX at the shared on-disk compile cache, with
+    the size/time floors zeroed so even small programs persist. Imports
+    jax (and may initialize its config layer, NOT the backend); returns
+    False instead of raising when the running jaxlib lacks the knobs, so
+    callers can log-and-continue."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir(repo_root))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception:
+        return False

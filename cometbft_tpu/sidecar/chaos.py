@@ -158,6 +158,19 @@ class ChaosBackend(VerifyBackend):
         inner_ping = getattr(self.inner, "ping", None)
         return inner_ping() if inner_ping is not None else True
 
+    def mesh_width(self) -> int:
+        # Shape, not weather: the supervisor's cap sizing must see the
+        # wrapped tier's real width (a chaos-wrapped fanout fleet still
+        # has the fleet's chips), so no fault draw here.
+        mw = getattr(self.inner, "mesh_width", None)
+        return int(mw()) if mw is not None else 1
+
+    def counters(self) -> dict:
+        inner_counters = getattr(self.inner, "counters", None)
+        out = dict(inner_counters()) if inner_counters is not None else {}
+        out["chaos_injected"] = dict(self.injected)
+        return out
+
     def close(self):
         inner_close = getattr(self.inner, "close", None)
         if inner_close is not None:

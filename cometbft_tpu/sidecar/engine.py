@@ -324,6 +324,11 @@ class VerificationEngine(VerifyBackend):
             new_cap = 16384 * width
             if new_cap > self.max_sigs:
                 self.max_sigs = new_cap
+                # The width grew (a sidecar Ping reply arrived, a fanout
+                # fleet came up): the dispatch-wall model must re-read
+                # rates at the new device count or deadline sizing keeps
+                # pricing the old, narrower chain.
+                self._rate_cache = None
         return self.max_sigs
 
     def ping(self):
@@ -353,7 +358,10 @@ class VerificationEngine(VerifyBackend):
                 rate = float(b._dev_rate) * max(1, int(b._n_dev))
                 overhead = float(getattr(b, "_dev_overhead", overhead))
                 break
-            for t in getattr(b, "tiers", ()) or ():
+            # LIFO stack: push tiers reversed so the CHAIN-ORDER head pops
+            # first — a fanout fleet tier must price the dispatch, not the
+            # narrower hybrid tier sitting below it in the chain.
+            for t in reversed(getattr(b, "tiers", ()) or ()):
                 stack.append(getattr(t, "backend", None))
             stack.append(getattr(b, "inner", None))
         model = (max(rate, 1e-6), max(overhead, 0.0))

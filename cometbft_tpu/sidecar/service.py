@@ -400,6 +400,14 @@ class SidecarServer:
             with self._device_lock:
                 ed25519_kernel.warmup(buckets)
 
+    @property
+    def bound_addr(self) -> str:
+        """host:port actually bound — differs from `addr` when the caller
+        asked for port 0 (the fanout shard workers and tests do, to dodge
+        port races; they print this so the parent learns the real port)."""
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
     def serve_forever(self):
         self._server.serve_forever()
 
@@ -817,7 +825,10 @@ def main() -> None:
     """`python -m cometbft_tpu.sidecar`: serve until killed."""
     addr = os.environ.get("CMTPU_SIDECAR_ADDR", DEFAULT_ADDR)
     server = SidecarServer(addr)
-    print(f"sidecar: serving on {addr} (backend={server.backend.name})", flush=True)
+    print(
+        f"sidecar: serving on {server.bound_addr} (backend={server.backend.name})",
+        flush=True,
+    )
     if os.environ.get("CMTPU_SIDECAR_WARM", "1") == "1":
         server.warmup()
         print("sidecar: warmup complete", flush=True)

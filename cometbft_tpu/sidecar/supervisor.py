@@ -147,6 +147,7 @@ class _Tier:
         self.trips = 0
         self.calls = 0
         self.failures = 0
+        self.width = 0  # last known mesh width (0 = never read)
 
 
 class ResilientBackend(VerifyBackend):
@@ -418,18 +419,34 @@ class ResilientBackend(VerifyBackend):
         )
 
     def mesh_width(self) -> int:
-        """Widest mesh any tier can reach — local chips (hybrid/tpu tiers)
-        or a remote pod's (the grpc tier's Ping capability reply). The
-        coalescer sizes its default merge cap from this."""
+        """Widest mesh any tier currently willing to serve can reach —
+        local chips (hybrid/tpu tiers), a remote pod's (the grpc tier's
+        Ping capability reply), or a whole fleet's (the fanout tier reports
+        the SUM of its shards' widths, because shards verify concurrently
+        while chain tiers are alternatives). The coalescer and engine size
+        their merge caps from this.
+
+        A tier whose breaker is open inside its cooldown is SKIPPED without
+        touching its backend — a tripped grpc tier must not be dialed just
+        to read its width — and every successful read is cached on the tier
+        (`tier.width`), so a tier that errors on the read keeps reporting
+        its last known width instead of vanishing from the estimate."""
         width = 1
+        now = time.monotonic()
         for tier in self.tiers:
-            mw = getattr(tier.backend, "mesh_width", None)
-            if mw is None:
-                continue
-            try:
-                width = max(width, int(mw()))
-            except Exception:
-                continue
+            with self._lock:
+                tripped = tier.state == _OPEN and (
+                    (now - tier.opened_at) * 1000 < self.breaker_cooldown_ms
+                )
+            if not tripped:
+                mw = getattr(tier.backend, "mesh_width", None)
+                if mw is not None:
+                    try:
+                        tier.width = max(1, int(mw()))
+                    except Exception:
+                        pass  # keep the cached width
+                if tier.width:
+                    width = max(width, tier.width)
         return width
 
     def ping(self) -> bool:
@@ -473,6 +490,7 @@ class ResilientBackend(VerifyBackend):
                 "calls": t.calls,
                 "failures": t.failures,
                 "trips": t.trips,
+                "width": t.width,
             }
             # Tier backends with their own counters (the grpc client's
             # streamed/unary split, a chaos wrapper's injections) surface
@@ -522,10 +540,13 @@ class ResilientBackend(VerifyBackend):
 
 
 def build_chain() -> list[tuple[str, VerifyBackend]]:
-    """The `grpc|tpu -> hybrid -> cpu` degradation order, from what this
-    process can actually reach:
+    """The `fanout|grpc|tpu -> hybrid -> cpu` degradation order, from what
+    this process can actually reach:
 
-    * a sidecar tier first, when `CMTPU_SIDECAR_ADDR` names one;
+    * the fleet tier first, when `CMTPU_FANOUT_PEERS` names sidecar peers
+      (sidecar/fanout.py — the widest tier; the local device tier rides it
+      as the `local` shard so its chips count toward the fleet width);
+    * a single-sidecar tier, when `CMTPU_SIDECAR_ADDR` names one;
     * the device tier `device_backend("auto")` selected (hybrid with an
       accelerator visible, nothing extra otherwise);
     * hybrid's own host tier as an intermediate when the device tier is
@@ -540,6 +561,12 @@ def build_chain() -> list[tuple[str, VerifyBackend]]:
     from cometbft_tpu.sidecar.chaos import ChaosBackend, faults_from_env
 
     tiers: list[tuple[str, VerifyBackend]] = []
+    primary = device_backend("auto")
+    from cometbft_tpu.sidecar.fanout import build_fanout
+
+    fan = build_fanout(primary if isinstance(primary, HybridBackend) else None)
+    if fan is not None:
+        tiers.append(("fanout", fan))
     addr = os.environ.get("CMTPU_SIDECAR_ADDR", "").strip()
     if addr:
         from cometbft_tpu.sidecar.service import GrpcBackend
@@ -547,7 +574,6 @@ def build_chain() -> list[tuple[str, VerifyBackend]]:
         deadline_ms = _env_float("CMTPU_DEADLINE_MS", 0.0)
         timeout_s = deadline_ms / 1000.0 if deadline_ms > 0 else 300.0
         tiers.append(("grpc", GrpcBackend(addr, timeout_s=timeout_s)))
-    primary = device_backend("auto")
     if isinstance(primary, HybridBackend):
         tiers.append(("hybrid", primary))
     anchor = primary if isinstance(primary, CpuBackend) else CpuBackend()

@@ -26,19 +26,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Share bench.py's persistent XLA compile cache: the sharded (shard_map)
+# Share the repo-wide persistent XLA compile cache: the sharded (shard_map)
 # programs the pod-scale mesh tests exercise cost tens of seconds each to
 # compile on XLA:CPU, and without this every tier-1 sweep re-pays them.
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-except Exception:
-    pass  # older jax without the persistent cache knobs
+from cometbft_tpu.ops import xla_cache  # noqa: E402
+
+xla_cache.enable_persistent_cache()
 
 
 def pytest_configure(config):
@@ -114,4 +107,12 @@ def pytest_configure(config):
         "device multi-pairing kernel); fast paths run in tier-1, the "
         "kernel-compile test carries `slow` too — `-m agg` selects "
         "just this group",
+    )
+    config.addinivalue_line(
+        "markers",
+        "fanout: multi-host fan-out tests (weighted slicing/reassembly, "
+        "per-shard failure redistribution, width-sum supervisor/engine "
+        "scaling, real shard-server processes); fast paths run in tier-1, "
+        "the multi-process mesh-shard rig carries `slow` too — "
+        "`-m fanout` selects just this group",
     )

@@ -335,3 +335,45 @@ def test_proposal_pol_message_sent_and_applied(net):
         cmsg.encode_consensus_message(pol_msgs[0]),
     )
     assert ps.proposal_pol_round == 0
+
+
+def test_stale_round_part_mark_does_not_suppress_current_round(net):
+    """Regression (round 15, the e2e matrix height stall): a block part
+    relayed ROUNDS LATE used to mark the peer as having the CURRENT
+    round's part — (height, index) keying — silently starving part gossip
+    for every later round of the height while proposals and votes (whose
+    keys carry the round) kept flowing.  Marks are round-scoped now: a
+    stale round-0 receipt must not block round-2's parts."""
+    cs, reactor, pvs, state, executor = net
+    rs = cs.rs
+    rs.votes.set_round(3)
+    rs.round = 2
+    rs.step = STEP_PREVOTE
+    block = executor.create_proposal_block(
+        1, state, Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+        pvs[0].address(),
+    )
+    parts = block.make_part_set()
+    proposal = Proposal(
+        height=1, round=2, pol_round=-1,
+        block_id=BlockID(block.hash(), parts.header()),
+        timestamp=Time(1700000001, 0),
+    )
+    rs.proposal = pvs[0].sign_proposal(CHAIN_ID, proposal)
+    rs.proposal_block_parts = parts
+
+    peer = FakePeer()
+    ps = PeerState(peer)
+    ps.height, ps.round, ps.step = 1, 2, STEP_PROPOSE
+    # The poisoning receipt: the peer relays round 0's part index 0 rounds
+    # late (receive-path bookkeeping keys it under its OWN round).
+    assert ps.mark_part_sent(1, 0, 0)
+
+    _gossip(reactor, ps)
+    got = {p.part.index for p in peer.msgs(cmsg.BlockPartMessage)}
+    assert got == set(range(parts.total)), "round-2 parts starved by stale mark"
+    # Each namespace stays independent: the round-0 mark survives, catchup
+    # marks (round -1) are their own space, and round-2 is now consumed.
+    assert not ps.mark_part_sent(1, 0, 0)
+    assert ps.mark_part_sent(1, -1, 0)
+    assert not ps.mark_part_sent(1, 2, 0)

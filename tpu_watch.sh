@@ -62,7 +62,7 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine stages ride in the
+      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine + fanout stages ride in the
       # carried JSON (host-side scheduler/admission/vote-batching/gateway
       # speedups measured while the device was serving); surface them in
       # the history. None gates alt-mode adoption below. Helper python is
@@ -110,6 +110,12 @@ parts.append(
     f"engine {e['consensus_p95_speedup']}x cons-p95 "
     f"{e['baseline_dispatches']}->{e['engine_dispatches']}dsp"
     if e else "engine absent")
+f = rec.get("stages", {}).get("fanout")
+parts.append(
+    f"fanout {f['speedup']}x {f['shards']}sh "
+    f"redis {f['redistributions']}"
+    + (" bit-identical" if f.get("bitmap_identical") else "")
+    if f else "fanout absent")
 print("; ".join(parts))
 PYEOF
       )
