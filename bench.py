@@ -2477,6 +2477,134 @@ def _fanout_stage(stages: dict, plog) -> None:
     )
 
 
+def _recvq_stage(stages: dict, plog) -> None:
+    """Recv-path QoS: block-part delivery p95 on a flooded connection,
+    prioritized demux vs the serialized baseline.
+
+    One real MConnection pair over a socketpair.  The receiver's on_receive
+    simulates reactor work (CMTPU_BENCH_RECVQ_HANDLE_MS per message — the
+    cost that serializes the legacy recv path).  Phase 1 lands a burst of
+    FLOOD mempool messages; phase 2 sends PARTS consensus-data messages
+    ("block parts") at a steady cadence while the flood backlog drains.
+    Baseline (CMTPU_RECVQ=0): each part waits behind every queued mempool
+    message.  Demux: the drain loop delivers consensus first, so part
+    latency collapses to ~one handler slot.  Both arms must deliver
+    bit-identical per-channel payload sequences (the demux reorders only
+    ACROSS channels, never within one)."""
+    import threading
+
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+
+    flood_n = int(os.environ.get("CMTPU_BENCH_RECVQ_FLOOD", "300"))
+    parts_n = int(os.environ.get("CMTPU_BENCH_RECVQ_PARTS", "20"))
+    handle_ms = float(os.environ.get("CMTPU_BENCH_RECVQ_HANDLE_MS", "2"))
+    CONS, MEMP = 0x21, 0x30
+    # Small flood payloads: the whole burst must fit in the socketpair's
+    # kernel buffer so the baseline backlog forms in the recv PROCESSING
+    # path (the serialization under test), not in sendall().
+    flood_msgs = [b"tx-%06d" % i for i in range(flood_n)]
+    part_msgs = [bytes([j % 256]) * 64 + b"part-%04d" % j for j in range(parts_n)]
+
+    def run_arm(demux: bool):
+        old_q = os.environ.get("CMTPU_RECVQ")
+        old_max = os.environ.get("CMTPU_RECVQ_MAX")
+        os.environ["CMTPU_RECVQ"] = "1" if demux else "0"
+        # No shedding in the A/B: bit-identity requires every message.
+        os.environ["CMTPU_RECVQ_MAX"] = str(flood_n + parts_n + 64)
+        a, b = socket.socketpair()
+        try:
+            seqs: dict[int, list] = {CONS: [], MEMP: []}
+            lat: list[float] = []
+            send_t: dict[bytes, float] = {}
+            done = threading.Event()
+
+            def on_recv(ch, msg):
+                time.sleep(handle_ms / 1000.0)  # simulated reactor work
+                if ch == CONS:
+                    lat.append(time.perf_counter() - send_t[msg])
+                seqs[ch].append(msg)
+                if len(seqs[CONS]) == parts_n and len(seqs[MEMP]) == flood_n:
+                    done.set()
+
+            descs = [
+                ChannelDescriptor(CONS, priority=10, send_queue_capacity=8192),
+                ChannelDescriptor(MEMP, priority=5, send_queue_capacity=8192),
+            ]
+            recv_c = MConnection(b, list(descs), on_recv, lambda e: None)
+            send_c = MConnection(
+                a, list(descs), lambda *x: None, lambda e: None
+            )
+            recv_c.start()
+            send_c.start()
+            for m in flood_msgs:
+                if not send_c.send(MEMP, m):
+                    raise AssertionError("flood send failed")
+            # Let the flood reach the wire before the first part goes out
+            # (the backlog must already be in front of it).
+            time.sleep(5 * handle_ms / 1000.0)
+            for m in part_msgs:
+                send_t[m] = time.perf_counter()
+                if not send_c.send(CONS, m):
+                    raise AssertionError("part send failed")
+                time.sleep(2 * handle_ms / 1000.0)
+            if not done.wait(timeout=60 + (flood_n + parts_n) * handle_ms / 500):
+                raise AssertionError(
+                    f"arm incomplete: {len(seqs[CONS])}/{parts_n} parts, "
+                    f"{len(seqs[MEMP])}/{flood_n} flood"
+                )
+            st = recv_c.recvq_stats()
+            send_c.stop()
+            recv_c.stop()
+            return lat, seqs, st
+        finally:
+            for sock in (a, b):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            for key, old in (("CMTPU_RECVQ", old_q), ("CMTPU_RECVQ_MAX", old_max)):
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
+
+    def p95(xs):
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(0.95 * len(s)))] * 1000.0
+
+    base_lat, base_seqs, _ = run_arm(demux=False)
+    demux_lat, demux_seqs, demux_stats = run_arm(demux=True)
+    order_identical = (
+        base_seqs[CONS] == demux_seqs[CONS] == part_msgs
+        and base_seqs[MEMP] == demux_seqs[MEMP] == flood_msgs
+    )
+    if not order_identical:  # pragma: no cover - acceptance guard
+        raise AssertionError("recvq per-channel delivery order diverged")
+    base_p95, demux_p95 = p95(base_lat), p95(demux_lat)
+    if base_p95 < 2.0 * demux_p95:  # pragma: no cover - acceptance guard
+        raise AssertionError(
+            f"recvq demux p95 {demux_p95:.2f} ms not >=2x better than "
+            f"serialized {base_p95:.2f} ms"
+        )
+    stages["recvq"] = {
+        "flood_msgs": flood_n,
+        "parts": parts_n,
+        "simulated_handle_ms": handle_ms,
+        "baseline_p95_ms": round(base_p95, 2),
+        "demux_p95_ms": round(demux_p95, 2),
+        "speedup": round(base_p95 / max(demux_p95, 1e-9), 2),
+        "order_identical": order_identical,
+        "demux_delivered": demux_stats.get("delivered_total", 0),
+        "demux_promoted": demux_stats.get("promoted_total", 0),
+        "demux_shed": demux_stats.get("shed_total", 0),
+    }
+    plog(
+        f"recvq: {flood_n} flood + {parts_n} parts @ {handle_ms} ms/handle: "
+        f"part p95 {base_p95:.1f} ms serialized -> {demux_p95:.1f} ms demux "
+        f"({stages['recvq']['speedup']}x), per-channel order identical"
+    )
+
+
 def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
     """BASELINE.md configs measured through the SHIPPED call path
     (types/validation -> crypto.batch -> backend), shared by the TPU worker
@@ -2602,6 +2730,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _fanout_stage(stages, plog)
         except Exception as e:
             plog(f"fanout stage failed: {type(e).__name__}: {e}")
+
+    # ---- recv-path QoS: prioritized demux vs serialized recv ----
+    if budget_left():
+        try:
+            _recvq_stage(stages, plog)
+        except Exception as e:
+            plog(f"recvq stage failed: {type(e).__name__}: {e}")
 
     # ---- BASELINE #3 tail on the host tier: all inclusion proofs ----
     if budget_left() and backend == "cpu":

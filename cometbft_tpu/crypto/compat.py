@@ -19,6 +19,18 @@ The pure tier is slower (≈2 ms/sign, ≈4 ms/verify, ≈1 ms per 1 KiB AEAD
 frame) but correct and wire-identical; consensus at e2e block intervals
 (200 ms+) is unaffected.  Nothing outside this module may import
 `cryptography` directly.
+
+For the AEAD specifically there is a middle tier: when the wheel is absent
+but the interpreter's own OpenSSL (`libcrypto`, already loaded for the ssl
+module) exposes `EVP_chacha20_poly1305`, a ctypes binding provides
+library-speed seal/open.  The pure tier's ≈1 ms/KiB is fatal on the p2p
+secret-connection hot path — every 1 KiB wire frame is sealed+opened once
+per hop, so a multi-node host caps out at a few dozen KiB/s per connection
+and block parts outlive the propose timeout.  The binding is cross-checked
+against the pure RFC 8439 implementation at import; any mismatch (or a
+libcrypto without the cipher) falls back to pure.  `AEAD_PROVIDER` names
+the active tier ("cryptography" | "libcrypto" | "pure");
+`CMTPU_PURE_AEAD=1` forces the pure tier for A/B and tests.
 """
 
 from __future__ import annotations
@@ -243,7 +255,7 @@ except ImportError:
     def _pad16(b: bytes) -> bytes:
         return b"\x00" * (-len(b) % 16)
 
-    class ChaCha20Poly1305:
+    class _PureChaCha20Poly1305:
         def __init__(self, key: bytes):
             if len(key) != 32:
                 raise ValueError("chacha20poly1305 key must be 32 bytes")
@@ -277,8 +289,189 @@ except ImportError:
                 raise InvalidTag("poly1305 tag mismatch")
             return _chacha20_xor(self._key, 1, nonce, ct)
 
+    # -- ChaCha20-Poly1305 via the interpreter's own libcrypto -------------
+
+    def _load_libcrypto_aead():
+        """Bind EVP_chacha20_poly1305 from the system libcrypto via ctypes.
+
+        Returns an AEAD class API-compatible with the `cryptography` wheel's
+        ChaCha20Poly1305, or None when the library / cipher is unavailable
+        or the binding fails its cross-check against the pure tier.
+        """
+        import ctypes
+        import ctypes.util
+
+        lib = None
+        names = [ctypes.util.find_library("crypto"), "libcrypto.so.3",
+                 "libcrypto.so.1.1", "libcrypto.so"]
+        for cand in names:
+            if not cand:
+                continue
+            try:
+                cdll = ctypes.CDLL(cand)
+            except OSError:
+                continue
+            if getattr(cdll, "EVP_chacha20_poly1305", None) is not None:
+                lib = cdll
+                break
+        if lib is None:
+            return None
+
+        c_int = ctypes.c_int
+        c_void_p = ctypes.c_void_p
+        c_char_p = ctypes.c_char_p
+        lib.EVP_chacha20_poly1305.restype = c_void_p
+        lib.EVP_chacha20_poly1305.argtypes = []
+        lib.EVP_CIPHER_CTX_new.restype = c_void_p
+        lib.EVP_CIPHER_CTX_new.argtypes = []
+        lib.EVP_CIPHER_CTX_free.restype = None
+        lib.EVP_CIPHER_CTX_free.argtypes = [c_void_p]
+        lib.EVP_CipherInit_ex.restype = c_int
+        lib.EVP_CipherInit_ex.argtypes = [
+            c_void_p, c_void_p, c_void_p, c_char_p, c_char_p, c_int,
+        ]
+        lib.EVP_CipherUpdate.restype = c_int
+        lib.EVP_CipherUpdate.argtypes = [
+            c_void_p, c_void_p, ctypes.POINTER(c_int), c_char_p, c_int,
+        ]
+        lib.EVP_CipherFinal_ex.restype = c_int
+        lib.EVP_CipherFinal_ex.argtypes = [
+            c_void_p, c_void_p, ctypes.POINTER(c_int),
+        ]
+        lib.EVP_CIPHER_CTX_ctrl.restype = c_int
+        lib.EVP_CIPHER_CTX_ctrl.argtypes = [c_void_p, c_int, c_int, c_void_p]
+
+        _SET_IVLEN, _GET_TAG, _SET_TAG = 0x09, 0x10, 0x11
+        cipher = lib.EVP_chacha20_poly1305()
+        if not cipher:
+            return None
+
+        class _LibcryptoChaCha20Poly1305:
+            """RFC 8439 AEAD over the already-loaded system libcrypto."""
+
+            def __init__(self, key: bytes):
+                if len(key) != 32:
+                    raise ValueError("chacha20poly1305 key must be 32 bytes")
+                self._key = bytes(key)
+
+            def _run(self, enc: int, nonce: bytes, data: bytes,
+                     aad: bytes, tag: bytes | None) -> bytes:
+                # Fresh context per call keeps concurrent send/recv AEADs
+                # (and any other threads) isolated without locking.
+                ctx = lib.EVP_CIPHER_CTX_new()
+                if not ctx:
+                    raise MemoryError("EVP_CIPHER_CTX_new failed")
+                try:
+                    outl = c_int(0)
+                    out = ctypes.create_string_buffer(len(data) or 1)
+                    ok = (
+                        lib.EVP_CipherInit_ex(ctx, cipher, None, None, None, enc)
+                        and lib.EVP_CIPHER_CTX_ctrl(ctx, _SET_IVLEN, 12, None)
+                        and lib.EVP_CipherInit_ex(
+                            ctx, None, None, self._key, bytes(nonce), enc
+                        )
+                    )
+                    if ok and aad:
+                        ok = lib.EVP_CipherUpdate(
+                            ctx, None, ctypes.byref(outl), aad, len(aad)
+                        )
+                    if ok:
+                        ok = lib.EVP_CipherUpdate(
+                            ctx, out, ctypes.byref(outl),
+                            bytes(data), len(data),
+                        )
+                    n = outl.value
+                    if ok and not enc:
+                        ok = lib.EVP_CIPHER_CTX_ctrl(
+                            ctx, _SET_TAG, 16,
+                            ctypes.create_string_buffer(tag, 16),
+                        )
+                    if ok:
+                        fin = lib.EVP_CipherFinal_ex(
+                            ctx, ctypes.byref(out, n), ctypes.byref(outl)
+                        )
+                        if not fin:
+                            if not enc:
+                                raise InvalidTag("poly1305 tag mismatch")
+                            ok = 0
+                        else:
+                            n += outl.value
+                    if not ok:
+                        raise ValueError("libcrypto chacha20poly1305 failed")
+                    if enc:
+                        tagbuf = ctypes.create_string_buffer(16)
+                        if not lib.EVP_CIPHER_CTX_ctrl(
+                            ctx, _GET_TAG, 16, tagbuf
+                        ):
+                            raise ValueError("EVP_CTRL_AEAD_GET_TAG failed")
+                        return out.raw[:n] + tagbuf.raw
+                    return out.raw[:n]
+                finally:
+                    lib.EVP_CIPHER_CTX_free(ctx)
+
+            def encrypt(self, nonce: bytes, data: bytes,
+                        aad: bytes | None) -> bytes:
+                if len(nonce) != 12:
+                    raise ValueError("nonce must be 12 bytes")
+                return self._run(1, nonce, bytes(data), aad or b"", None)
+
+            def decrypt(self, nonce: bytes, data: bytes,
+                        aad: bytes | None) -> bytes:
+                if len(nonce) != 12:
+                    raise ValueError("nonce must be 12 bytes")
+                if len(data) < 16:
+                    raise InvalidTag("ciphertext too short")
+                data = bytes(data)
+                return self._run(
+                    0, nonce, data[:-16], aad or b"", data[-16:]
+                )
+
+        # Cross-check against the pure RFC 8439 tier before trusting the
+        # binding: wire bytes must be identical and tampering must raise.
+        try:
+            key = bytes(range(32))
+            nonce = bytes(range(12))
+            for msg, aad in (
+                (b"", b""),
+                (b"tpu-bft frame", b"hdr"),
+                (bytes(1024) + b"tail", b""),
+            ):
+                fast = _LibcryptoChaCha20Poly1305(key)
+                pure = _PureChaCha20Poly1305(key)
+                sealed = fast.encrypt(nonce, msg, aad)
+                if sealed != pure.encrypt(nonce, msg, aad):
+                    return None
+                if fast.decrypt(nonce, sealed, aad) != msg:
+                    return None
+                try:
+                    fast.decrypt(
+                        nonce, sealed[:-1] + bytes([sealed[-1] ^ 1]), aad
+                    )
+                    return None
+                except InvalidTag:
+                    pass
+        except Exception:
+            return None
+        return _LibcryptoChaCha20Poly1305
+
+    _libcrypto_aead = (
+        None
+        if os.environ.get("CMTPU_PURE_AEAD")
+        else _load_libcrypto_aead()
+    )
+    if _libcrypto_aead is not None:
+        ChaCha20Poly1305 = _libcrypto_aead
+        AEAD_PROVIDER = "libcrypto"
+    else:
+        ChaCha20Poly1305 = _PureChaCha20Poly1305
+        AEAD_PROVIDER = "pure"
+
+if HAVE_CRYPTOGRAPHY:
+    AEAD_PROVIDER = "cryptography"
+
 
 __all__ = [
+    "AEAD_PROVIDER",
     "HAVE_CRYPTOGRAPHY",
     "InvalidSignature",
     "InvalidTag",

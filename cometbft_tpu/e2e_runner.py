@@ -64,7 +64,7 @@ ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
-    "light_gateway", "mixed_load",
+    "light_gateway", "mixed_load", "recv_flood",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -264,6 +264,9 @@ class E2ERunner:
         # Per-node results of the mixed_load perturbation (tx flood + light
         # swarm driven CONCURRENTLY: all engine classes contend at once).
         self._mixed_loads: dict[str, dict] = {}
+        # Per-node results of the recv_flood perturbation (gossip-side
+        # mempool flood pressuring the target's prioritized recv demux).
+        self._recv_floods: dict[str, dict] = {}
         # Stall forensics: every node's consensus round-state, captured at
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
@@ -623,6 +626,14 @@ class E2ERunner:
             if flood_t.is_alive() or swarm_t.is_alive():
                 raise AssertionError(f"{name}: mixed_load arm never finished")
             self._mixed_loads[name] = results
+        elif kind == "recv_flood":
+            # No process disruption: the flooded BYTES are the perturbation.
+            # Other nodes' mempools gossip a sustained tx stream into the
+            # target's recv path; with the old serialized recv loop this is
+            # exactly the seeds-2/3/9 stall shape (block parts queued behind
+            # tx bytes past timeout_propose).  The prioritized demux must
+            # keep consensus committing through the flood.
+            self._recv_floods[name] = self._recv_flood(node)
         elif kind == "concurrent_light_clients":
             # No process disruption: the stress IS the perturbation.  N
             # light clients bisect against this node simultaneously; their
@@ -1167,6 +1178,83 @@ class E2ERunner:
             "lane_depths_after": after.get("lane_depths"),
         }
 
+    def _recv_flood(self, node: ManifestNode, duration_s: float = 6.0) -> dict:
+        """Gossip-side recv flood: pump legacy txs into every OTHER node so
+        mempool gossip saturates `node`'s inbound p2p connections while
+        consensus block parts keep arriving on the same sockets.  Asserts
+        the prioritized demux is live on the target (recvq_stats RPC),
+        that the chain keeps advancing DURING the flood (the serialized
+        recv path's failure mode was zero progress), and that the
+        per-class counters show both mempool and consensus traffic was
+        delivered through the queues."""
+        from cometbft_tpu.loadtime import make_payload
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        name = node.name
+        cli = HTTPClient(f"http://127.0.0.1:{self.rpc_ports[name]}", timeout=5)
+        before = cli.call("recvq_stats")
+        if not before.get("enabled"):
+            raise AssertionError(f"{name}: recv demux not enabled")
+        others = [n.name for n in self.manifest.nodes if n.name != name] or [name]
+        start_h = self._height(name)
+        stop = threading.Event()
+        offered = [0]
+
+        def flood(target: str) -> None:
+            fcli = HTTPClient(
+                f"http://127.0.0.1:{self.rpc_ports[target]}", timeout=3
+            )
+            k = 0
+            while not stop.is_set():
+                tx = make_payload(k, time.time_ns())
+                try:
+                    fcli.call("broadcast_tx_async", tx="0x" + tx.hex())
+                    offered[0] += 1
+                except Exception:
+                    pass
+                k += 1
+                time.sleep(0.002)
+
+        threads = [
+            threading.Thread(target=flood, args=(t,), daemon=True)
+            for t in others
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        end_h = self._height(name)
+        after = cli.call("recvq_stats")
+        delta = {
+            k: after[k] - before.get(k, 0)
+            for k in after
+            if isinstance(after.get(k), int) and isinstance(before.get(k, 0), int)
+        }
+        if end_h <= start_h:
+            raise AssertionError(
+                f"{name}: no commit during a {duration_s}s recv flood "
+                f"({offered[0]} txs offered) — consensus bytes starved"
+            )
+        if delta.get("mempool_delivered", 0) <= 0:
+            raise AssertionError(
+                f"{name}: flood never reached the recv demux: {delta}"
+            )
+        if delta.get("consensus_delivered", 0) <= 0:
+            raise AssertionError(
+                f"{name}: no consensus traffic through the demux during "
+                f"the flood: {delta}"
+            )
+        return {
+            "flood_offered": offered[0],
+            "flood_senders": len(others),
+            "blocks_during_flood": end_h - start_h,
+            "recvq_delta": delta,
+            "max_delay_us_after": after.get("max_delay_us", 0),
+            "promoted_during": delta.get("promoted_total", 0),
+        }
+
     # -- the run ----------------------------------------------------------
 
     def _run_sim(self) -> dict:
@@ -1297,6 +1385,8 @@ class E2ERunner:
                 report["vote_batch"] = self._vote_batches
             if self._mixed_loads:
                 report["mixed_load"] = self._mixed_loads
+            if self._recv_floods:
+                report["recv_flood"] = self._recv_floods
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

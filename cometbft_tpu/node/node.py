@@ -189,6 +189,7 @@ class Node:
                            lambda: self.block_store.base())
             self._register_backend_metrics(reg)
             self._register_engine_metrics(reg)
+            self._register_recvq_metrics(reg)
             self._register_mesh_metrics(reg)
             self._register_fanout_metrics(reg)
             self._register_hotpath_metrics(reg)
@@ -545,6 +546,86 @@ class Node:
                     ]
                 ),
             )
+
+    def _register_recvq_metrics(self, reg) -> None:
+        """recvq_* gauges: the prioritized p2p recv demux, aggregated across
+        every live peer connection plus retired-peer totals (per-channel
+        queue depth, per-class deliveries, sheds, starvation promotions,
+        max queue delay).  Lazy like the backend gauges — the sampler reads
+        `self.switch` via getattr (registration runs before __init__ builds
+        it) and the switch only walks already-built MConnections, so a
+        scrape never constructs anything.  Empty/zero under CMTPU_RECVQ=0."""
+
+        def _stats():
+            sw = getattr(self, "switch", None)
+            if sw is None:
+                return None
+            try:
+                return sw.recvq_stats()
+            except Exception:
+                return None
+
+        def rq(key):
+            def fn():
+                st = _stats()
+                return int(st.get(key, 0)) if st else 0
+
+            return fn
+
+        reg.gauge_func("recvq", "depth",
+                       "Messages queued in recv demux queues (all peers).",
+                       rq("depth"))
+        reg.gauge_func("recvq", "delivered_total",
+                       "Messages the recv demux delivered to reactors.",
+                       rq("delivered_total"))
+        reg.gauge_func("recvq", "shed_total",
+                       "Sheddable-class messages dropped on queue overflow.",
+                       rq("shed_total"))
+        reg.gauge_func("recvq", "promoted_total",
+                       "Messages promoted past higher-class backlog by the "
+                       "starvation hatch.",
+                       rq("promoted_total"))
+        reg.gauge_func("recvq", "backpressure_waits",
+                       "Framer waits on a full consensus/blocksync queue "
+                       "(TCP backpressure engaged).",
+                       rq("backpressure_waits"))
+        reg.gauge_func("recvq", "max_delay_us",
+                       "Worst observed recv queue delay, microseconds.",
+                       rq("max_delay_us"))
+        from cometbft_tpu.p2p.conn.recvq import CLASS_NAMES as _RQ_CLASSES
+
+        for cname in _RQ_CLASSES:
+            reg.gauge_func(
+                "recvq", f"{cname}_delivered",
+                f"{cname}-class messages delivered by the recv demux.",
+                rq(f"{cname}_delivered"),
+            )
+        # Per-channel depth over the reserved global channel ids
+        # (p2p/reactor.py); unknown future channels still show up in the
+        # recvq_stats RPC's `channels` map.
+        from cometbft_tpu.p2p import reactor as _reactor_mod
+
+        for chan in (
+            _reactor_mod.PEX_CHANNEL,
+            _reactor_mod.CONSENSUS_STATE_CHANNEL,
+            _reactor_mod.CONSENSUS_DATA_CHANNEL,
+            _reactor_mod.CONSENSUS_VOTE_CHANNEL,
+            _reactor_mod.CONSENSUS_VOTE_SET_BITS_CHANNEL,
+            _reactor_mod.MEMPOOL_CHANNEL,
+            _reactor_mod.EVIDENCE_CHANNEL,
+            _reactor_mod.BLOCKSYNC_CHANNEL,
+            _reactor_mod.SNAPSHOT_CHANNEL,
+            _reactor_mod.CHUNK_CHANNEL,
+        ):
+            def chan_depth(c=chan):
+                st = _stats()
+                if not st:
+                    return 0
+                return int(st.get("channels", {}).get(f"{c:#04x}", 0))
+
+            reg.gauge_func("recvq", f"depth_ch{chan:02x}",
+                           f"Recv demux queue depth on channel {chan:#04x}.",
+                           chan_depth)
 
     @staticmethod
     def _register_mesh_metrics(reg) -> None:

@@ -16,6 +16,7 @@ import threading
 import time
 
 from cometbft_tpu.libs import flowrate
+from cometbft_tpu.p2p.conn import recvq
 from cometbft_tpu.wire import proto as wire
 
 DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
@@ -25,6 +26,17 @@ PING_INTERVAL = 60.0
 PONG_TIMEOUT = 45.0
 FLUSH_THROTTLE = 0.01
 MAX_MSG_SIZE = 104857600
+
+
+class UnknownChannelError(ValueError):
+    """The remote sent a packet for a channel id this connection never
+    registered — a peer-level protocol violation, surfaced through
+    ``on_error`` so the switch tears the peer down (and, for persistent
+    peers, redials)."""
+
+    def __init__(self, chan_id: int):
+        super().__init__(f"unknown channel {chan_id:#x}")
+        self.chan_id = chan_id
 
 
 class ChannelDescriptor:
@@ -64,6 +76,7 @@ class MConnection:
         max_packet_msg_payload_size: int = DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE,
         send_rate: int = DEFAULT_SEND_RATE,
         recv_rate: int = DEFAULT_RECV_RATE,
+        clock=None,
     ):
         self._conn = conn
         self.channels = {d.id: _Channel(d) for d in channel_descs}
@@ -80,19 +93,48 @@ class MConnection:
         self._running = False
         self._pong_pending = False
         self._last_msg_recv = time.monotonic()
+        # Prioritized recv demux (CMTPU_RECVQ, default on): _recv_routine
+        # frames + enqueues; the demux's drain thread delivers in priority
+        # order.  Off = the historical inline delivery, verbatim.
+        self._recvq = None
+        if recvq.enabled():
+            self._recvq = recvq.RecvQueues(
+                lambda ch, msg: self.on_receive(ch, msg),
+                channels=self.channels,
+                clock=clock,
+                on_error=self._fatal,
+            )
 
     def start(self) -> None:
         self._running = True
+        if self._recvq is not None:
+            self._recvq.start()
         threading.Thread(target=self._send_routine, daemon=True).start()
         threading.Thread(target=self._recv_routine, daemon=True).start()
 
     def stop(self) -> None:
         self._running = False
         self._send_signal.set()
+        if self._recvq is not None:
+            self._recvq.stop()
         try:
             self._conn.close()
         except Exception:
             pass
+
+    def recvq_stats(self) -> dict:
+        """Demux counters ({} when the demux is disabled)."""
+        return self._recvq.stats() if self._recvq is not None else {}
+
+    def _fatal(self, e: Exception) -> None:
+        """Shared death path for the send/recv/drain threads: stop once,
+        surface the first error through on_error."""
+        was_running = self._running
+        self._running = False
+        if self._recvq is not None:
+            self._recvq.stop()
+        if was_running and self.on_error:
+            self.on_error(e)
 
     # -- sending (conn/connection.go:422 sendRoutine) -------------------------
 
@@ -135,9 +177,7 @@ class MConnection:
                     self._send_signal.wait(FLUSH_THROTTLE)
                     self._send_signal.clear()
             except Exception as e:
-                self._running = False
-                if self.on_error:
-                    self.on_error(e)
+                self._fatal(e)
                 return
 
     def _send_some_packets(self) -> bool:
@@ -189,6 +229,10 @@ class MConnection:
     # -- receiving (conn/connection.go recvRoutine) ---------------------------
 
     def _recv_routine(self) -> None:
+        """Thin framer: decode packets, reassemble messages at EOF markers,
+        then hand off.  With the demux on, completed messages are enqueued
+        into the per-channel recv queues and the demux's drain thread calls
+        on_receive in priority order; off, delivery stays inline here."""
         while self._running:
             try:
                 pkt = self._read_packet()
@@ -206,18 +250,18 @@ class MConnection:
                     data = wire.get_bytes(mf, 3)
                     ch = self.channels.get(chan_id)
                     if ch is None:
-                        raise ValueError(f"unknown channel {chan_id:#x}")
+                        raise UnknownChannelError(chan_id)
                     ch.recving += data
                     if len(ch.recving) > ch.desc.recv_message_capacity:
                         raise ValueError("received message exceeds channel capacity")
                     if eof:
                         msg, ch.recving = ch.recving, b""
-                        self.on_receive(chan_id, msg)
+                        if self._recvq is not None:
+                            self._recvq.push(chan_id, msg)
+                        else:
+                            self.on_receive(chan_id, msg)
             except Exception as e:
-                was_running = self._running
-                self._running = False
-                if was_running and self.on_error:
-                    self.on_error(e)
+                self._fatal(e)
                 return
 
     def _read_packet(self) -> bytes:
@@ -232,8 +276,11 @@ class MConnection:
         ln, _ = wire.decode_uvarint(hdr, 0)
         if ln > MAX_MSG_SIZE:
             raise ValueError("packet too large")
-        self.recv_monitor.limit(ln, self._recv_rate)
-        self.recv_monitor.update(ln)
+        # Rate-account the whole frame: the varint header was already read
+        # off the wire above, so limiting only the payload undercounted
+        # every packet by its header size.
+        self.recv_monitor.limit(len(hdr) + ln, self._recv_rate)
+        self.recv_monitor.update(len(hdr) + ln)
         return self._read_exact(ln)
 
     def _read_exact(self, n: int) -> bytes:

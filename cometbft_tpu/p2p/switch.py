@@ -46,7 +46,8 @@ def redial_delay(attempt: int) -> float:
 class Peer:
     """p2p/peer.go peer: MConnection + metadata."""
 
-    def __init__(self, up: UpgradedConn, channel_descs, on_receive, on_error):
+    def __init__(self, up: UpgradedConn, channel_descs, on_receive, on_error,
+                 clock=None):
         self.node_info = up.node_info
         self.id = up.peer_id
         self.is_outbound = up.outbound
@@ -57,6 +58,7 @@ class Peer:
             channel_descs,
             lambda ch, msg: on_receive(self, ch, msg),
             lambda err: on_error(self, err),
+            clock=clock,
         )
 
     def start(self) -> None:
@@ -105,6 +107,9 @@ class Switch:
         # Peer instances whose connection died before they reached the
         # table (stop_peer_for_error in _add_peer's start->insert window).
         self._dead: set[Peer] = set()
+        # Recv-demux counters folded in from stopped peers, so node-level
+        # recvq_* gauges survive peer churn (depths die with the queues).
+        self._recvq_retired: dict = {}
 
     # -- reactors -------------------------------------------------------------
 
@@ -173,7 +178,8 @@ class Switch:
             if up.peer_id in self._peers:
                 up.conn.close()
                 return
-        peer = Peer(up, self._channel_descs, self._on_peer_receive, self._on_peer_error)
+        peer = Peer(up, self._channel_descs, self._on_peer_receive,
+                    self._on_peer_error, clock=self.clock)
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
         peer.start()
@@ -280,6 +286,7 @@ class Switch:
                 self._dead.add(peer)
                 while len(self._dead) > 256:
                     self._dead.pop()
+        self._fold_recvq(peer)
         # Always stop THIS instance's threads, but only the instance that
         # owns the table entry may tear down reactor state: a dead
         # connection errors from both its send and recv routines, and with
@@ -293,6 +300,63 @@ class Switch:
             return
         for reactor in self.reactors.values():
             reactor.remove_peer(peer, reason)
+
+    def _fold_recvq(self, peer: Peer) -> None:
+        """Accumulate a dying peer's demux counters exactly once (a dead
+        connection reaches stop_peer_for_error from both its send and recv
+        routines)."""
+        if getattr(peer, "_recvq_folded", False):
+            return
+        peer._recvq_folded = True
+        try:
+            st = peer.mconn.recvq_stats()
+        except Exception:
+            return
+        if not st:
+            return
+        with self._mtx:
+            for key, v in st.items():
+                if not isinstance(v, int) or key == "depth":
+                    continue
+                if key == "max_delay_us":
+                    self._recvq_retired[key] = max(
+                        self._recvq_retired.get(key, 0), v
+                    )
+                else:
+                    self._recvq_retired[key] = self._recvq_retired.get(key, 0) + v
+
+    def recvq_stats(self) -> dict:
+        """Aggregate recv-demux counters across live peers + retired totals
+        (the recvq_* node gauges and the recvq_stats RPC read this)."""
+        with self._mtx:
+            out: dict = {"enabled": False, **self._recvq_retired}
+            if self._recvq_retired:
+                out["enabled"] = True
+        channels: dict[str, int] = {}
+        for p in self.peers():
+            try:
+                st = p.mconn.recvq_stats()
+            except Exception:
+                continue
+            if not st:
+                continue
+            out["enabled"] = True
+            for key, v in st.items():
+                if key == "channels":
+                    for cid, d in v.items():
+                        channels[cid] = channels.get(cid, 0) + d
+                elif isinstance(v, int):
+                    if key == "max_delay_us":
+                        out[key] = max(out.get(key, 0), v)
+                    else:
+                        out[key] = out.get(key, 0) + v
+        out["channels"] = channels
+        out.setdefault("depth", 0)
+        out.setdefault("delivered_total", 0)
+        out.setdefault("shed_total", 0)
+        out.setdefault("promoted_total", 0)
+        out.setdefault("max_delay_us", 0)
+        return out
 
     # -- routing --------------------------------------------------------------
 

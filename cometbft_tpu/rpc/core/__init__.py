@@ -398,6 +398,10 @@ def routes(env: Environment) -> dict:
                     else [],
                 },
             },
+            # Stall forensics: a proposal that arrives on time at the
+            # switch but seconds late at the state machine shows up here
+            # as a deep message queue.
+            "msg_queue_depth": cs._queue.qsize(),
             "peers": peers,
         }
 
@@ -544,6 +548,15 @@ def routes(env: Environment) -> dict:
         if env.ingress is None:
             return {"enabled": False}
         return {"enabled": True, **env.ingress.stats()}
+
+    def recvq_stats():
+        """Recv-demux counters (per-class deliveries, sheds, promotions,
+        queue depth) aggregated across peer connections — operators and
+        the e2e recv_flood perturbation's delta checks."""
+        fn = getattr(env.p2p_peers, "recvq_stats", None)
+        if fn is None:
+            return {"enabled": False}
+        return fn()
 
     # ---- light-client gateway (light/gateway.py) ---------------------------
 
@@ -781,6 +794,7 @@ def routes(env: Environment) -> dict:
         "broadcast_tx_commit": broadcast_tx_commit,
         "check_tx": check_tx,
         "ingress_stats": ingress_stats,
+        "recvq_stats": recvq_stats,
         "light_sync": light_sync,
         "light_proof": light_proof,
         "light_gateway_stats": light_gateway_stats,

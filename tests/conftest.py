@@ -116,3 +116,11 @@ def pytest_configure(config):
         "the multi-process mesh-shard rig carries `slow` too — "
         "`-m fanout` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "recvq: recv-path QoS tests (prioritized per-channel demux DRR "
+        "drain order, shed/backpressure overflow policy, starvation "
+        "promotion, bit-identical delivery demux on vs off, "
+        "unknown-channel peer teardown, recv flow accounting); runs in "
+        "tier-1 — `-m recvq` selects just this group",
+    )

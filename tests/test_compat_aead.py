@@ -1,0 +1,90 @@
+"""AEAD provider tiers in crypto/compat.
+
+The secret-connection hot path seals/opens one 1 KiB frame per wire
+packet, so the AEAD provider must be both fast and wire-identical across
+tiers: `cryptography` wheel, ctypes libcrypto binding, pure RFC 8439.
+These tests pin the cross-tier equivalence that the import-time
+cross-check relies on, plus the RFC 8439 vector the pure tier was built
+against.
+"""
+
+import os
+
+import pytest
+
+from cometbft_tpu.crypto import compat
+
+pytestmark = pytest.mark.recvq
+
+# RFC 8439 §2.8.2 test vector.
+_KEY = bytes(range(0x80, 0xA0))
+_NONCE = bytes.fromhex("070000004041424344454647")
+_AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+_PT = (
+    b"Ladies and Gentlemen of the class of '99: If I could offer you "
+    b"only one tip for the future, sunscreen would be it."
+)
+_CT = bytes.fromhex(
+    "d31a8d34648e60db7b86afbc53ef7ec2"
+    "a4aded51296e08fea9e2b5a736ee62d6"
+    "3dbea45e8ca9671282fafb69da92728b"
+    "1a71de0a9e060b2905d6a5b67ecd3b36"
+    "92ddbd7f2d778b8c9803aee328091b58"
+    "fab324e4fad675945585808b4831d7bc"
+    "3ff4def08e4b7a9de576d26586cec64b"
+    "6116"
+)
+_TAG = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+
+
+class TestAEADProvider:
+    def test_provider_named(self):
+        assert compat.AEAD_PROVIDER in ("cryptography", "libcrypto", "pure")
+
+    def test_rfc8439_vector(self):
+        aead = compat.ChaCha20Poly1305(_KEY)
+        assert aead.encrypt(_NONCE, _PT, _AAD) == _CT + _TAG
+        assert aead.decrypt(_NONCE, _CT + _TAG, _AAD) == _PT
+
+    def test_tamper_raises(self):
+        aead = compat.ChaCha20Poly1305(_KEY)
+        sealed = bytearray(aead.encrypt(_NONCE, _PT, _AAD))
+        sealed[-1] ^= 1
+        with pytest.raises(compat.InvalidTag):
+            aead.decrypt(_NONCE, bytes(sealed), _AAD)
+
+    def test_empty_and_unaligned_frames(self):
+        aead = compat.ChaCha20Poly1305(_KEY)
+        for msg in (b"", b"x", os.urandom(63), os.urandom(1028)):
+            sealed = aead.encrypt(_NONCE, msg, None)
+            assert len(sealed) == len(msg) + 16
+            assert aead.decrypt(_NONCE, sealed, None) == msg
+
+    def test_active_tier_matches_pure(self):
+        """Whatever tier won at import, its wire bytes equal the pure tier's."""
+        pure = getattr(compat, "_PureChaCha20Poly1305", None)
+        if pure is None:
+            pytest.skip("cryptography wheel active; pure tier not constructed")
+        fast = compat.ChaCha20Poly1305(_KEY)
+        ref = pure(_KEY)
+        for msg, aad in ((b"", b""), (_PT, _AAD), (os.urandom(4096), b"")):
+            assert fast.encrypt(_NONCE, msg, aad) == ref.encrypt(_NONCE, msg, aad)
+
+    def test_libcrypto_binding_when_available(self):
+        """The ctypes tier must load on hosts whose libcrypto has the cipher.
+
+        Guards against a silent regression to the ≈1 ms/KiB pure tier —
+        that is the block-part throughput collapse the recvq PR root-caused.
+        """
+        if compat.HAVE_CRYPTOGRAPHY:
+            pytest.skip("cryptography wheel takes precedence")
+        loader = getattr(compat, "_load_libcrypto_aead", None)
+        assert loader is not None
+        cls = loader()
+        if cls is None:
+            pytest.skip("host libcrypto lacks EVP_chacha20_poly1305")
+        assert compat.AEAD_PROVIDER == "libcrypto" or os.environ.get(
+            "CMTPU_PURE_AEAD"
+        )
+        aead = cls(_KEY)
+        assert aead.encrypt(_NONCE, _PT, _AAD) == _CT + _TAG

@@ -71,7 +71,7 @@ def test_full_profile_reaches_every_dimension():
         assert any(n["key_type"] == kt for n in nodes), kt
     for p in ("kill", "pause", "disconnect", "restart", "backend_faults",
               "concurrent_light_clients", "tx_flood", "vote_batch",
-              "light_gateway", "mixed_load"):
+              "light_gateway", "mixed_load", "recv_flood"):
         assert any(p in n["perturb"] for n in nodes), p
 
 
@@ -188,25 +188,18 @@ def test_matrix_smoke(tmp_path):
     reach its target and agree on one block hash (the matrix acceptance
     bar).  Prefers seeds that exercise a backend_faults perturbation (the
     chaos-injected supervised chain), a late join, and an external ABCI
-    boundary so the smoke covers more than the trivial corner."""
-    # Seeds pinned out after root-causing (round 15): all three stall the
-    # same way — block proposals/parts queue behind bulk traffic in the
-    # per-connection SERIALIZED recv path (channel priorities only shape
-    # the SEND side) and cross timeout_propose, so every round prevotes
-    # nil.  Seeds 2/3: the bulk traffic is a sustained tx flood (WAL
-    # forensics: proposal crosses in <1 s, the block PART takes 3-4 s).
-    # Seed 9: the trigger is the vote-rebroadcast storm after the
-    # backend_faults heal restart — height 6 livelocks 22 rounds with
-    # proposals landing 1-5 s past each round's propose deadline while
-    # the un-committed block grows (1 -> 3 parts) from the accumulating
-    # mempool; reproduced bit-for-bit from a clean pre-round-15 checkout,
-    # so pre-existing, not a fanout regression.  Two real bugs found on
-    # the way ARE fixed (the (height,index) part-sent key poisoning in
-    # consensus/reactor.py and the churn settle race in e2e_runner.py);
-    # the residual needs recv-side prioritization — tracked in
-    # ROADMAP.md.  Repro:
-    #   python -m cometbft_tpu.cmd e2e matrix --seeds 2,3,9 --profile small
-    known_stall = {2, 3, 9}
+    boundary so the smoke covers more than the trivial corner.
+
+    History: seeds 2/3/9 were pinned out of this pool after the round-15
+    root-cause — block proposals/parts queued behind bulk traffic in the
+    per-connection SERIALIZED recv path (channel priorities only shaped
+    the SEND side) and crossed timeout_propose, so every round prevoted
+    nil (seeds 2/3: a sustained tx flood; seed 9: the vote-rebroadcast
+    storm after the backend_faults heal restart — WAL forensics showed
+    the proposal crossing in <1 s while the block PART took 3-4 s).  The
+    round-18 prioritized recv demux (p2p/conn/recvq.py) removes exactly
+    that serialization, so the pin is gone; test_matrix_unpinned_seeds
+    below holds the three named seeds green."""
     faulted = _seeds_with(
         "small",
         lambda s: any("backend_faults" in n["perturb"] for n in s["nodes"]),
@@ -223,7 +216,7 @@ def test_matrix_smoke(tmp_path):
         if len(seeds) == 3:
             break
         for s in pool:
-            if s not in seeds and s not in known_stall:
+            if s not in seeds:
                 seeds.append(s)
                 break
     assert len(seeds) == 3
@@ -233,5 +226,22 @@ def test_matrix_smoke(tmp_path):
     )
     assert summary["failed"] == [], summary
     for seed in seeds:
+        rep = summary["results"][str(seed)]["report"]
+        assert len(rep["agreed_hash"]) == 64
+
+
+@pytest.mark.slow
+def test_matrix_unpinned_seeds(tmp_path):
+    """Seeds 2/3/9 — the round-15 serialized-recv stalls — through the
+    real runner.  These are THE regression fixture for the prioritized
+    recv demux: with CMTPU_RECVQ=0 (or before round 18) each one stalls
+    with proposals prevoting nil behind bulk recv traffic.  Repro:
+      python -m cometbft_tpu.cmd e2e matrix --seeds 2,3,9 --profile small
+    """
+    summary = run_matrix(
+        [2, 3, 9], str(tmp_path), profile="small", log=lambda s: None
+    )
+    assert summary["failed"] == [], summary
+    for seed in (2, 3, 9):
         rep = summary["results"][str(seed)]["report"]
         assert len(rep["agreed_hash"]) == 64

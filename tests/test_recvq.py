@@ -1,0 +1,484 @@
+"""Recv-path QoS: the prioritized per-channel demux (p2p/conn/recvq.py)
+behind MConnection's recv routine — DRR drain order, shed/backpressure
+overflow policy, starvation promotion, bit-identical per-channel delivery
+demux on vs off, unknown-channel peer teardown, and the recv flow-rate
+accounting fix."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.p2p.conn import recvq
+from cometbft_tpu.p2p.conn.connection import (
+    ChannelDescriptor,
+    MConnection,
+    UnknownChannelError,
+)
+from cometbft_tpu.p2p.conn.recvq import (
+    CLASS_BLOCKSYNC,
+    CLASS_CONSENSUS,
+    CLASS_MEMPOOL,
+    CLASS_OTHER,
+    RecvQueues,
+)
+from cometbft_tpu.wire import proto as wire
+
+pytestmark = pytest.mark.recvq
+
+
+class FakeClock:
+    """Deterministic simnet-surface clock for synchronous scheduler tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _queues(chans, **kw):
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("max_depth", 10_000)
+    kw.setdefault("starvation_ms", 10_000_000.0)
+    return RecvQueues(lambda c, m: None, channels=dict.fromkeys(chans), **kw)
+
+
+# -- classification + knobs ------------------------------------------------
+
+
+def test_classify_covers_reserved_channels():
+    from cometbft_tpu.p2p import reactor as r
+
+    assert recvq.classify(r.CONSENSUS_STATE_CHANNEL) == CLASS_CONSENSUS
+    assert recvq.classify(r.CONSENSUS_DATA_CHANNEL) == CLASS_CONSENSUS
+    assert recvq.classify(r.CONSENSUS_VOTE_CHANNEL) == CLASS_CONSENSUS
+    assert recvq.classify(r.CONSENSUS_VOTE_SET_BITS_CHANNEL) == CLASS_CONSENSUS
+    assert recvq.classify(r.BLOCKSYNC_CHANNEL) == CLASS_BLOCKSYNC
+    assert recvq.classify(r.EVIDENCE_CHANNEL) == CLASS_BLOCKSYNC
+    assert recvq.classify(r.SNAPSHOT_CHANNEL) == CLASS_BLOCKSYNC
+    assert recvq.classify(r.CHUNK_CHANNEL) == CLASS_BLOCKSYNC
+    assert recvq.classify(r.MEMPOOL_CHANNEL) == CLASS_MEMPOOL
+    assert recvq.classify(r.PEX_CHANNEL) == CLASS_OTHER
+    assert recvq.classify(0x99) == CLASS_OTHER
+
+
+def test_enabled_env_parsing(monkeypatch):
+    monkeypatch.delenv("CMTPU_RECVQ", raising=False)
+    assert recvq.enabled()
+    for off in ("0", "false", "OFF"):
+        monkeypatch.setenv("CMTPU_RECVQ", off)
+        assert not recvq.enabled()
+    monkeypatch.setenv("CMTPU_RECVQ", "1")
+    assert recvq.enabled()
+
+
+# -- DRR drain order -------------------------------------------------------
+
+
+def test_drr_drains_classes_high_to_low():
+    """One full DRR cycle delivers quantum messages per backlogged class,
+    consensus first — mempool enqueued FIRST must still drain after it."""
+    rq = _queues([0x21, 0x40, 0x30, 0x00], quanta=(8, 4, 2, 1))
+    for i in range(10):
+        rq.push(0x30, b"m%d" % i)
+    for i in range(10):
+        rq.push(0x21, b"c%d" % i)
+    for i in range(5):
+        rq.push(0x40, b"b%d" % i)
+    for i in range(3):
+        rq.push(0x00, b"p%d" % i)
+    order = [rq._select_locked() for _ in range(15)]
+    classes = [recvq.classify(item[0]) for item in order]
+    assert classes == (
+        [CLASS_CONSENSUS] * 8 + [CLASS_BLOCKSYNC] * 4
+        + [CLASS_MEMPOOL] * 2 + [CLASS_OTHER]
+    )
+    # Within-channel FIFO: consensus came out in push order.
+    cons = [m for cid, m, _, _ in order if cid == 0x21]
+    assert cons == [b"c%d" % i for i in range(8)]
+
+
+def test_drr_low_classes_progress_under_consensus_storm():
+    """The out-weighted classes still advance every cycle — strict priority
+    with liveness, not starvation."""
+    rq = _queues([0x21, 0x30], quanta=(8, 4, 2, 1))
+    for i in range(100):
+        rq.push(0x21, b"c%d" % i)
+    for i in range(10):
+        rq.push(0x30, b"m%d" % i)
+    got = [rq._select_locked()[0] for _ in range(30)]
+    # 30 pops = three full cycles: 8 consensus + 2 mempool each.
+    assert got.count(0x30) == 6
+    assert [m for m in got[:8]] == [0x21] * 8
+
+
+def test_drain_exhausts_everything():
+    rq = _queues([0x21, 0x30, 0x00])
+    n = 0
+    for cid in (0x21, 0x30, 0x00):
+        for i in range(7):
+            rq.push(cid, b"%02x-%d" % (cid, i))
+            n += 1
+    seen = []
+    for _ in range(n):
+        item = rq._select_locked()
+        assert item is not None
+        seen.append(item)
+    assert rq._select_locked() is None
+    per_chan = {}
+    for cid, m, _, _ in seen:
+        per_chan.setdefault(cid, []).append(m)
+    for cid in (0x21, 0x30, 0x00):
+        assert per_chan[cid] == [b"%02x-%d" % (cid, i) for i in range(7)]
+
+
+# -- starvation hatch ------------------------------------------------------
+
+
+def test_starvation_promotes_stale_low_class_head():
+    clk = FakeClock()
+    rq = _queues([0x21, 0x30], clock=clk, starvation_ms=100.0)
+    rq.push(0x30, b"old-tx")
+    clk.sleep(0.3)  # tx is now 300 ms old, 3x the bound
+    for i in range(5):
+        rq.push(0x21, b"c%d" % i)
+    cid, msg, _, promoted = rq._select_locked()
+    assert (cid, msg) == (0x30, b"old-tx")
+    assert promoted, "bypassing backlogged consensus must count as promotion"
+    # With the stale head gone, consensus drains normally, not promoted.
+    cid, _, _, promoted = rq._select_locked()
+    assert cid == 0x21 and not promoted
+
+
+def test_stale_high_class_head_is_not_counted_promoted():
+    """The hatch may pick a stale consensus head, but that's not a
+    promotion — nothing of higher class was bypassed."""
+    clk = FakeClock()
+    rq = _queues([0x21, 0x30], clock=clk, starvation_ms=100.0)
+    rq.push(0x21, b"old-part")
+    clk.sleep(0.3)
+    rq.push(0x30, b"tx")
+    cid, msg, _, promoted = rq._select_locked()
+    assert (cid, msg) == (0x21, b"old-part")
+    assert not promoted
+
+
+# -- overflow policy: shed vs backpressure ---------------------------------
+
+
+def test_mempool_overflow_sheds_arriving_message():
+    rq = _queues([0x30, 0x21], max_depth=2)
+    assert rq.push(0x30, b"a") and rq.push(0x30, b"b")
+    assert not rq.push(0x30, b"c"), "sheddable-class overflow must drop"
+    st = rq.stats()
+    assert st["shed_total"] == 1 and st["mempool_shed"] == 1
+    assert st["consensus_shed"] == 0
+    # The queue kept the FIRST two — shed drops the arrival, not the head.
+    assert rq._select_locked()[1] == b"a"
+
+
+def test_consensus_overflow_backpressures_the_framer():
+    """A full consensus queue parks push() until the drain makes room —
+    never drops — and the wait is visible in the counters."""
+    release = threading.Event()
+    delivered = []
+
+    def deliver(cid, msg):
+        release.wait(5)
+        delivered.append(msg)
+
+    rq = RecvQueues(
+        deliver, channels={0x21: None}, max_depth=1, starvation_ms=1e9
+    )
+    rq.start()
+    try:
+        assert rq.push(0x21, b"p0")  # drain pops it, blocks in deliver
+        deadline = time.monotonic() + 5
+        while not rq.push(0x21, b"p1"):  # noqa: B007 - fills the queue
+            assert time.monotonic() < deadline
+        done = threading.Event()
+
+        def blocked_push():
+            assert rq.push(0x21, b"p2")
+            done.set()
+
+        t = threading.Thread(target=blocked_push, daemon=True)
+        t.start()
+        assert not done.wait(0.4), "push must block on a full consensus queue"
+        release.set()
+        assert done.wait(5), "push must complete once the drain made room"
+        deadline = time.monotonic() + 5
+        while len(delivered) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert delivered == [b"p0", b"p1", b"p2"]
+        assert rq.stats()["backpressure_waits"] > 0
+        assert rq.stats()["shed_total"] == 0
+    finally:
+        release.set()
+        rq.stop()
+
+
+# -- MConnection integration -----------------------------------------------
+
+
+def _mconn_pair(descs, on_recv, on_err=lambda e: None):
+    a, b = socket.socketpair()
+    recv_c = MConnection(b, list(descs), on_recv, on_err)
+    send_c = MConnection(a, list(descs), lambda *x: None, lambda e: None)
+    recv_c.start()
+    send_c.start()
+    return a, b, send_c, recv_c
+
+
+def test_demux_on_off_bit_identical_per_channel(monkeypatch):
+    """The demux may reorder across channels but each channel's payload
+    sequence must be byte-for-byte the serialized path's."""
+    descs = [
+        ChannelDescriptor(0x21, priority=10, send_queue_capacity=512),
+        ChannelDescriptor(0x30, priority=5, send_queue_capacity=512),
+    ]
+    sent = {0x21: [b"part-%d" % i for i in range(40)],
+            0x30: [b"tx-%d" % i for i in range(160)]}
+    results = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("CMTPU_RECVQ", mode)
+        got = {0x21: [], 0x30: []}
+        done = threading.Event()
+
+        def on_recv(ch, msg, got=got):
+            got[ch].append(msg)
+            if len(got[0x21]) == 40 and len(got[0x30]) == 160:
+                done.set()
+
+        a, b, send_c, recv_c = _mconn_pair(descs, on_recv)
+        try:
+            assert (recv_c._recvq is not None) == (mode == "1")
+            # Interleave: 4 txs between every part.
+            for i in range(40):
+                for j in range(4):
+                    assert send_c.send(0x30, sent[0x30][4 * i + j])
+                assert send_c.send(0x21, sent[0x21][i])
+            assert done.wait(20), f"mode {mode}: incomplete delivery"
+            results[mode] = got
+        finally:
+            send_c.stop()
+            recv_c.stop()
+            a.close()
+            b.close()
+    for ch in (0x21, 0x30):
+        assert results["0"][ch] == results["1"][ch] == sent[ch]
+    # and the demux actually ran in mode 1
+    assert results["1"] is not None
+
+
+def test_unknown_channel_surfaces_named_error_and_stops():
+    errors = []
+    got_err = threading.Event()
+
+    def on_err(e):
+        errors.append(e)
+        got_err.set()
+
+    descs = [ChannelDescriptor(0x21, priority=10)]
+    a, b, send_c, recv_c = _mconn_pair(descs, lambda *x: None, on_err)
+    try:
+        # Craft a packet for a channel the receiver never registered; the
+        # sender-side API refuses unregistered ids, so write the frame raw.
+        pkt = (
+            wire.field_varint(1, 0x99)
+            + wire.field_bool(2, True)
+            + wire.field_bytes(3, b"bogus")
+        )
+        a.sendall(wire.length_delimited(wire.field_message(3, pkt, emit_empty=True)))
+        assert got_err.wait(5), "unknown channel never surfaced"
+        assert isinstance(errors[0], UnknownChannelError)
+        assert errors[0].chan_id == 0x99
+        assert "0x99" in str(errors[0])
+        assert not recv_c._running, "connection must stop on protocol violation"
+        # Teardown is idempotent: the late second routine's death is silent.
+        assert len(errors) == 1
+    finally:
+        send_c.stop()
+        recv_c.stop()
+        a.close()
+        b.close()
+
+
+def test_recv_flow_accounting_counts_header_and_payload():
+    """recv_monitor must account the varint length header, not just the
+    payload — sender and receiver totals agree byte-for-byte."""
+    done = threading.Event()
+    descs = [ChannelDescriptor(0x21, priority=10)]
+    a, b, send_c, recv_c = _mconn_pair(descs, lambda ch, m: done.set())
+    try:
+        assert send_c.send(0x21, b"x" * 300)
+        assert done.wait(5)
+        deadline = time.monotonic() + 5
+        while recv_c.recv_monitor.bytes_total < send_c.send_monitor.bytes_total:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert recv_c.recv_monitor.bytes_total == send_c.send_monitor.bytes_total
+        # The framed packet's 2-byte varint header is in the count.
+        assert recv_c.recv_monitor.bytes_total > 300
+    finally:
+        send_c.stop()
+        recv_c.stop()
+        a.close()
+        b.close()
+
+
+# -- switch level ----------------------------------------------------------
+
+
+def _make_switch(name, clock=None):
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.node_info import NodeInfo
+    from cometbft_tpu.p2p.switch import Switch
+    from cometbft_tpu.p2p.transport import MultiplexTransport
+
+    nk = NodeKey()
+    ni = NodeInfo(node_id=nk.id, network="recvq-test", moniker=name)
+    return Switch(ni, MultiplexTransport(ni, nk), clock=clock), nk
+
+
+def test_unknown_channel_tears_peer_down_and_redial_recovers():
+    """A peer framing traffic for an unregistered channel is a protocol
+    violation: the receiving switch must evict it via on_error, and a
+    fresh dial must then succeed (no wedged table entry)."""
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor as CD
+    from cometbft_tpu.p2p.reactor import Reactor
+
+    class Echo(Reactor):
+        def __init__(self):
+            super().__init__("echo")
+            self.event = threading.Event()
+
+        def get_channels(self):
+            return [CD(0x77, priority=5)]
+
+        def receive(self, chan_id, peer, msg):
+            self.event.set()
+
+    sw1, _ = _make_switch("n1")
+    sw2, nk2 = _make_switch("n2")
+    r1, r2 = Echo(), Echo()
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    addr2 = sw2.start("127.0.0.1:0")
+    sw1.start("")
+    try:
+        peer = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        assert peer is not None
+        for _ in range(100):
+            if sw2.num_peers() == 1:
+                break
+            time.sleep(0.05)
+        # Inject a frame for an id neither side registered, bypassing the
+        # sender-side channel check.
+        pkt = (
+            wire.field_varint(1, 0xEE)
+            + wire.field_bool(2, True)
+            + wire.field_bytes(3, b"rogue")
+        )
+        peer.mconn._write_packet(wire.field_message(3, pkt, emit_empty=True))
+        for _ in range(100):
+            if sw2.num_peers() == 0:
+                break
+            time.sleep(0.05)
+        assert sw2.num_peers() == 0, "violating peer must be evicted"
+        # The evicted peer's counters folded into the switch aggregate.
+        st2 = sw2.recvq_stats()
+        assert st2["enabled"]
+        # Recovery: a clean redial works and traffic flows.  Wait for the
+        # dialer side to notice the dropped conn first (dup-id guard).
+        sw1.stop_peer_for_error(peer, "test: rogue frame sent")
+        for _ in range(100):
+            if sw1.num_peers() == 0:
+                break
+            time.sleep(0.05)
+        peer2 = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        assert peer2 is not None
+        assert peer2.send(0x77, b"hello-again")
+        assert r2.event.wait(5), "redialed peer must deliver"
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+def test_switch_recvq_stats_aggregates_live_peers():
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor as CD
+    from cometbft_tpu.p2p.reactor import Reactor
+
+    class Echo(Reactor):
+        def __init__(self):
+            super().__init__("echo")
+            self.n = 0
+            self.event = threading.Event()
+
+        def get_channels(self):
+            return [CD(0x77, priority=5)]
+
+        def receive(self, chan_id, peer, msg):
+            self.n += 1
+            if self.n >= 5:
+                self.event.set()
+
+    sw1, _ = _make_switch("n1")
+    sw2, nk2 = _make_switch("n2")
+    r2 = Echo()
+    sw1.add_reactor("echo", Echo())
+    sw2.add_reactor("echo", r2)
+    addr2 = sw2.start("127.0.0.1:0")
+    sw1.start("")
+    try:
+        assert sw2.recvq_stats()["enabled"] is False  # no peers yet
+        peer = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        for i in range(5):
+            assert peer.send(0x77, b"m%d" % i)
+        assert r2.event.wait(5)
+        st = sw2.recvq_stats()
+        assert st["enabled"] and st["delivered_total"] >= 5
+        assert st["other_delivered"] >= 5  # 0x77 classifies as other
+        assert st["shed_total"] == 0
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+def test_simnet_clock_reaches_the_demux():
+    """Switch(clock=...) must thread the injected clock down to every
+    connection's demux so queue ages run on virtual time in simnet."""
+    clk = FakeClock()
+    sw1, _ = _make_switch("n1", clock=clk)
+    sw2, nk2 = _make_switch("n2")
+    from cometbft_tpu.p2p.conn.connection import ChannelDescriptor as CD
+    from cometbft_tpu.p2p.reactor import Reactor
+
+    class Quiet(Reactor):
+        def __init__(self):
+            super().__init__("q")
+
+        def get_channels(self):
+            return [CD(0x77, priority=5)]
+
+        def receive(self, chan_id, peer, msg):
+            pass
+
+    sw1.add_reactor("q", Quiet())
+    sw2.add_reactor("q", Quiet())
+    addr2 = sw2.start("127.0.0.1:0")
+    sw1.start("")
+    try:
+        peer = sw1.dial_peer(f"{nk2.id}@{addr2}")
+        assert peer is not None
+        assert peer.mconn._recvq is not None
+        assert peer.mconn._recvq._clock is clk
+    finally:
+        sw1.stop()
+        sw2.stop()

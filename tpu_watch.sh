@@ -62,7 +62,7 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine + fanout stages ride in the
+      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine + fanout + recvq stages ride in the
       # carried JSON (host-side scheduler/admission/vote-batching/gateway
       # speedups measured while the device was serving); surface them in
       # the history. None gates alt-mode adoption below. Helper python is
@@ -116,6 +116,12 @@ parts.append(
     f"redis {f['redistributions']}"
     + (" bit-identical" if f.get("bitmap_identical") else "")
     if f else "fanout absent")
+rq = rec.get("stages", {}).get("recvq")
+parts.append(
+    f"recvq {rq['speedup']}x part-p95 {rq['baseline_p95_ms']}->"
+    f"{rq['demux_p95_ms']}ms"
+    + (" order-identical" if rq.get("order_identical") else "")
+    if rq else "recvq absent")
 print("; ".join(parts))
 PYEOF
       )
