@@ -1645,6 +1645,88 @@ def _simnet_stage(stages: dict, plog) -> None:
     }
 
 
+def _byz_stage(stages: dict, plog) -> None:
+    """Byzantine simnet accountability (ISSUE 19): the same seeded scenario
+    run honest, with an equivocator under a partition+heal, and with a
+    vote-flooder.  Reports the evidence pipeline's sim-latency (conflict
+    detection -> DuplicateVoteEvidence committed in a block), the honest
+    block-rate ratio under each adversary, and post-window recovery lag.
+    Knobs: CMTPU_BENCH_BYZ_VALS (20), CMTPU_BENCH_BYZ_BLOCKS (10),
+    CMTPU_BENCH_BYZ_FLOOD_HZ (10)."""
+    from cometbft_tpu.simnet.scenario import run_scenario
+
+    vals = int(os.environ.get("CMTPU_BENCH_BYZ_VALS", "") or 20)
+    blocks = int(os.environ.get("CMTPU_BENCH_BYZ_BLOCKS", "") or 10)
+    flood_hz = float(os.environ.get("CMTPU_BENCH_BYZ_FLOOD_HZ", "") or 10.0)
+    base = dict(
+        validators=vals, blocks=blocks, seed=1234, jitter_ms=5.0,
+        max_sim_s=40.0 * blocks + 200.0,
+        partitions=[{"at_s": 20.0, "heal_s": 45.0, "fraction": 0.5}],
+    )
+
+    def _arm(name: str, **kw) -> dict:
+        rep = run_scenario(**{**base, **kw})
+        committed = rep["height_node0"] - 1
+        rate = (
+            round(committed / rep["sim_time_s"], 4) if rep["sim_time_s"] else 0.0
+        )
+        ev = rep["evidence"]
+        out = {
+            "ok": rep["ok"],
+            "safety_ok": rep["safety_ok"],
+            "sim_blocks_per_s": rate,
+            "sim_time_s": rep["sim_time_s"],
+            "accel": rep["accel"],
+            "evidence_detections": ev["detections"],
+            "evidence_committed": ev["committed_count"],
+            "evidence_commit_sim_s": ev["first_commit_sim_s"],
+            "detect_to_commit_s": ev["detect_to_commit_s"],
+            "recovery_lag_s": rep["recovery"].get("recovery_lag_s"),
+        }
+        plog(
+            f"byz[{name}]: {committed} blocks, {rate} blocks/sim-s, "
+            f"safety={rep['safety_ok']}, "
+            f"evidence {ev['detections']} detected / "
+            f"{ev['committed_count']} committed"
+            + (
+                f" (detect->commit {ev['detect_to_commit_s']} sim-s)"
+                if ev["detect_to_commit_s"] is not None else ""
+            )
+        )
+        return out
+
+    arms = {
+        "honest": _arm("honest"),
+        "equivocator": _arm(
+            "equivocator",
+            byzantine=[{
+                "role": "equivocator", "node": 1, "from_s": 10.0,
+                "until_s": 50.0, "only_partitioned": True,
+            }],
+        ),
+        "vote_flood": _arm(
+            "vote_flood",
+            byzantine=[{
+                "role": "flooder", "node": 1, "from_s": 10.0,
+                "until_s": 50.0, "rate_hz": flood_hz,
+            }],
+        ),
+    }
+    b = arms["honest"]["sim_blocks_per_s"] or 1.0
+    stages["byz"] = {
+        "validators": vals,
+        "blocks": blocks,
+        "flood_hz": flood_hz,
+        **{f"{k}_{m}": v for k, a in arms.items() for m, v in a.items()},
+        "block_rate_equivocator_ratio": round(
+            arms["equivocator"]["sim_blocks_per_s"] / b, 3
+        ),
+        "block_rate_vote_flood_ratio": round(
+            arms["vote_flood"]["sim_blocks_per_s"] / b, 3
+        ),
+    }
+
+
 def _lightgw_stage(stages: dict, plog) -> None:
     """Light-client gateway (ISSUE 7): N concurrent light clients sync the
     same span, independent bisections vs one shared gateway.
@@ -2702,6 +2784,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _simnet_stage(stages, plog)
         except Exception as e:
             plog(f"simnet stage failed: {type(e).__name__}: {e}")
+
+    # ---- byz: byzantine simnet arms, evidence-commit latency ----
+    if budget_left():
+        try:
+            _byz_stage(stages, plog)
+        except Exception as e:
+            plog(f"byz stage failed: {type(e).__name__}: {e}")
 
     # ---- aggregate BLS commits: scalar / host / device multi-pairing ----
     if budget_left():

@@ -226,6 +226,31 @@ def _generate_sim_spec(seed: int, rng: random.Random) -> dict:
             else []
         ),
     }
+    # Byzantine window (round 19): about half the seeds run one adversary.
+    # Equivocators are biased toward the partition window with
+    # only_partitioned set — the accountability path (heal -> vote-knowledge
+    # merge -> DuplicateVoteEvidence committed) is the property under test.
+    if rng.random() < 0.5:
+        role = rng.choice(("equivocator", "equivocator", "withholder", "flooder"))
+        entry = {
+            "role": role,
+            "node": rng.randint(1, validators - 1),
+            "from_s": round(rng.uniform(5.0, part_at), 1),
+            "until_s": round(sim["partitions"][0]["heal_s"]
+                             + rng.uniform(5.0, 20.0), 1),
+        }
+        if role == "equivocator":
+            entry["only_partitioned"] = rng.random() < 0.5
+        sim["byzantine"] = [entry]
+    # Occasional in-sim blocksync late-join, never colliding with the
+    # adversary (a byzantine joiner is rejected by the scenario).
+    if rng.random() < 0.3:
+        taken = {e["node"] for e in sim.get("byzantine", [])}
+        candidates = [i for i in range(1, validators) if i not in taken]
+        sim["joins"] = [{
+            "node": rng.choice(candidates),
+            "at_s": round(rng.uniform(30.0, 60.0), 1),
+        }]
     return {"seed": seed, "profile": "sim", "network": "sim", "sim": sim}
 
 
@@ -316,6 +341,35 @@ def _render_sim_toml(spec: dict) -> str:
         )
         lines.append(
             "churn_nodes = [" + ", ".join(str(c["nodes"]) for c in churn) + "]"
+        )
+    byz = sim.get("byzantine", [])
+    if byz:
+        lines.append(
+            "byz_role = [" + ", ".join(f'"{b["role"]}"' for b in byz) + "]"
+        )
+        lines.append(
+            "byz_node = [" + ", ".join(str(b["node"]) for b in byz) + "]"
+        )
+        lines.append(
+            "byz_from_s = [" + ", ".join(str(b["from_s"]) for b in byz) + "]"
+        )
+        lines.append(
+            "byz_until_s = [" + ", ".join(str(b["until_s"]) for b in byz) + "]"
+        )
+        lines.append(
+            "byz_only_partitioned = ["
+            + ", ".join(
+                _toml_bool(bool(b.get("only_partitioned", False))) for b in byz
+            )
+            + "]"
+        )
+    joins = sim.get("joins", [])
+    if joins:
+        lines.append(
+            "join_node = [" + ", ".join(str(j["node"]) for j in joins) + "]"
+        )
+        lines.append(
+            "join_at_s = [" + ", ".join(str(j["at_s"]) for j in joins) + "]"
         )
     return "\n".join(lines) + "\n"
 

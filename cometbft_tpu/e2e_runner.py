@@ -205,10 +205,36 @@ class Manifest:
                 sim_raw.pop("churn_nodes", []),
             )
         ]
+        byz = [
+            {"role": r, "node": n, "from_s": f, "until_s": u}
+            for r, n, f, u in zip(
+                sim_raw.pop("byz_role", []),
+                sim_raw.pop("byz_node", []),
+                sim_raw.pop("byz_from_s", []),
+                sim_raw.pop("byz_until_s", []),
+            )
+        ]
+        # only_partitioned is an equivocator-only knob; the aligned array
+        # carries false placeholders for other roles (make_actor rejects
+        # the key elsewhere).
+        for entry, op in zip(byz, sim_raw.pop("byz_only_partitioned", [])):
+            if entry["role"] == "equivocator":
+                entry["only_partitioned"] = bool(op)
+        joins = [
+            {"node": n, "at_s": a}
+            for n, a in zip(
+                sim_raw.pop("join_node", []),
+                sim_raw.pop("join_at_s", []),
+            )
+        ]
         if parts:
             sim_raw["partitions"] = parts
         if churn:
             sim_raw["churn"] = churn
+        if byz:
+            sim_raw["byzantine"] = byz
+        if joins:
+            sim_raw["joins"] = joins
         sim = default_spec(**sim_raw)  # validates: unknown keys raise
         return cls(
             network="sim",
@@ -1276,6 +1302,11 @@ class E2ERunner:
             raise AssertionError(
                 f"simnet hash disagreement at height {report['agreed_height']}"
             )
+        if not report.get("safety_ok", True):
+            raise AssertionError(
+                "simnet SAFETY VIOLATION: conflicting honest commits at "
+                f"heights {report['conflicting_heights']}"
+            )
         if not report["ok"]:
             # Height never reached: the stall signature (run_matrix maps
             # TimeoutError to `stalled`, same as a wall-clock wait_height).
@@ -1300,7 +1331,8 @@ class E2ERunner:
                 for k in (
                     "seed", "agreed_height", "agreed_hash", "stragglers",
                     "sim_time_s", "wall_time_s", "accel", "events",
-                    "counters", "block_hashes",
+                    "counters", "block_hashes", "safety_ok", "evidence",
+                    "recovery", "joins",
                 )
             },
         }

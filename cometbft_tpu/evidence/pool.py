@@ -42,6 +42,14 @@ class EvidencePool:
         # Conflicting votes reported by consensus, turned into evidence on the
         # next Update (pool.go processConsensusBuffer analog).
         self._consensus_buffer: list = []
+        # Lifetime counters surfaced by evidence_* gauges / evidence_stats
+        # (simnet soak assertions and live nodes read the same numbers).
+        self.stats = {
+            "reported_total": 0,   # conflicting-vote reports from consensus
+            "added_total": 0,      # evidence accepted into pending
+            "committed_total": 0,  # evidence marked committed via Update
+            "expired_total": 0,    # pending pruned past max-age
+        }
 
     # -- ingest ---------------------------------------------------------------
 
@@ -52,12 +60,14 @@ class EvidencePool:
                 return
             verify_evidence(ev, self.state, self.state_store, self.block_store)
             self._db.set(_key(_PENDING_PREFIX, ev), encode_evidence(ev))
+            self.stats["added_total"] += 1
 
     def report_conflicting_votes(self, vote_a, vote_b) -> None:
         """consensus hook (pool.go ReportConflictingVotes): buffered, turned
         into DuplicateVoteEvidence against the right validator set at Update."""
         with self._mtx:
             self._consensus_buffer.append((vote_a, vote_b))
+            self.stats["reported_total"] += 1
 
     def _process_consensus_buffer(self, state) -> None:
         with self._mtx:
@@ -73,6 +83,7 @@ class EvidencePool:
                 with self._mtx:
                     if not self._is_pending(ev) and not self._is_committed(ev):
                         self._db.set(_key(_PENDING_PREFIX, ev), encode_evidence(ev))
+                        self.stats["added_total"] += 1
             except Exception as e:
                 if self.logger:
                     self.logger.error(f"failed to generate evidence from conflicting votes: {e}")
@@ -105,6 +116,7 @@ class EvidencePool:
             if not self._is_pending(ev):
                 verify_evidence(ev, self.state, self.state_store, self.block_store)
                 self._db.set(_key(_PENDING_PREFIX, ev), encode_evidence(ev))
+                self.stats["added_total"] += 1
 
     def update(self, state, evidence: list) -> None:
         """pool.go:105-130 Update: mark committed, prune expired."""
@@ -114,6 +126,7 @@ class EvidencePool:
         for ev in evidence:
             self._db.set(_key(_COMMITTED_PREFIX, ev), b"\x01")
             self._db.delete(_key(_PENDING_PREFIX, ev))
+            self.stats["committed_total"] += 1
         self._process_consensus_buffer(state)
         self._prune_expired()
 
@@ -129,8 +142,22 @@ class EvidencePool:
             )
             if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
                 self._db.delete(k)
+                self.stats["expired_total"] += 1
 
     # -- queries --------------------------------------------------------------
+
+    def pending_count(self) -> int:
+        """Number of evidence pieces currently pending inclusion."""
+        return sum(
+            1 for _ in self._db.iterator(_PENDING_PREFIX, _PENDING_PREFIX + b"\xff")
+        )
+
+    def stats_snapshot(self) -> dict:
+        """One coherent read for gauges / RPC / simnet soak assertions."""
+        with self._mtx:
+            out = dict(self.stats)
+        out["pending"] = self.pending_count()
+        return out
 
     def _is_pending(self, ev) -> bool:
         return self._db.has(_key(_PENDING_PREFIX, ev))

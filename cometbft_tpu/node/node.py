@@ -194,6 +194,7 @@ class Node:
             self._register_fanout_metrics(reg)
             self._register_hotpath_metrics(reg)
             self._register_lightgw_metrics(reg)
+            self._register_evidence_metrics(reg)
             addr = config.instrumentation.prometheus_listen_addr
             host, _, port = addr.rpartition(":")
             self.metrics_server = MetricsServer(
@@ -626,6 +627,41 @@ class Node:
             reg.gauge_func("recvq", f"depth_ch{chan:02x}",
                            f"Recv demux queue depth on channel {chan:#04x}.",
                            chan_depth)
+
+    def _register_evidence_metrics(self, reg) -> None:
+        """evidence_* gauges: the misbehavior-accountability pipeline
+        (pending pool size, lifetime reported/added/committed/expired).
+        Lazy like the other families — the sampler reads
+        `self.evidence_pool` via getattr, and `pending` walks only the
+        pool's own DB prefix, so a scrape never constructs anything."""
+
+        def ev(key):
+            def fn():
+                pool = getattr(self, "evidence_pool", None)
+                if pool is None:
+                    return 0
+                try:
+                    return int(pool.stats_snapshot().get(key, 0))
+                except Exception:
+                    return 0
+
+            return fn
+
+        reg.gauge_func("evidence", "pending",
+                       "Evidence pieces pending inclusion in a block.",
+                       ev("pending"))
+        reg.gauge_func("evidence", "reported_total",
+                       "Conflicting-vote reports received from consensus.",
+                       ev("reported_total"))
+        reg.gauge_func("evidence", "added_total",
+                       "Evidence pieces accepted into the pending pool.",
+                       ev("added_total"))
+        reg.gauge_func("evidence", "committed_total",
+                       "Evidence pieces committed in blocks.",
+                       ev("committed_total"))
+        reg.gauge_func("evidence", "expired_total",
+                       "Pending evidence pruned past max-age.",
+                       ev("expired_total"))
 
     @staticmethod
     def _register_mesh_metrics(reg) -> None:

@@ -403,6 +403,12 @@ def routes(env: Environment) -> dict:
             # as a deep message queue.
             "msg_queue_depth": cs._queue.qsize(),
             "peers": peers,
+            # Accountability forensics: same counters as the evidence_*
+            # gauges, so a soak assertion and a live dump read one source.
+            "evidence_stats": (
+                env.evidence_pool.stats_snapshot()
+                if env.evidence_pool is not None else None
+            ),
         }
 
     def consensus_state():

@@ -164,9 +164,20 @@ class ResilientBackend(VerifyBackend):
         breaker_threshold: int | None = None,
         breaker_cooldown_ms: float | None = None,
         crosscheck: str | None = None,
+        clock=None,
     ):
         if not tiers:
             raise ValueError("ResilientBackend needs at least one tier")
+        # Injected Clock surface (simnet/clock.py): breaker timestamps and
+        # retry backoff run on it, so tests can pin breaker/backoff timing
+        # to virtual time on a loaded host. Call DEADLINES stay on the
+        # worker's real `Event.wait` — a wedged jax dispatch wedges in wall
+        # time no matter what the test clock says.
+        if clock is None:
+            from cometbft_tpu.simnet.clock import MonotonicClock
+
+            clock = MonotonicClock()
+        self._clock = clock
         self.tiers = [_Tier(n, b) for n, b in tiers]
         self.deadline_ms = (
             _env_float("CMTPU_DEADLINE_MS", 0.0) if deadline_ms is None else deadline_ms
@@ -215,7 +226,7 @@ class ResilientBackend(VerifyBackend):
         with self._lock:
             if tier.state == _CLOSED:
                 return True
-            if (time.monotonic() - tier.opened_at) * 1000 < self.breaker_cooldown_ms:
+            if (self._clock.now() - tier.opened_at) * 1000 < self.breaker_cooldown_ms:
                 return False
             tier.state = _HALF_OPEN
             return True
@@ -235,7 +246,7 @@ class ResilientBackend(VerifyBackend):
                     tier.trips += 1
                     self.counters_["trips"] += 1
                 tier.state = _OPEN
-                tier.opened_at = time.monotonic()
+                tier.opened_at = self._clock.now()
                 tier.consecutive_failures = 0
 
     def _probe(self, tier: _Tier) -> bool:
@@ -275,7 +286,7 @@ class ResilientBackend(VerifyBackend):
                 with self._lock:
                     self.counters_["retries"] += 1
                 base = self.backoff_ms * (2 ** (attempt - 1))
-                time.sleep((base + self._jitter.uniform(0, base)) / 1000.0)
+                self._clock.sleep((base + self._jitter.uniform(0, base)) / 1000.0)
 
     def _call(self, op_name: str, fn_for, crosscheckable: bool = False):
         """Walk the chain: first admitted tier that answers wins.  `fn_for`
@@ -432,7 +443,7 @@ class ResilientBackend(VerifyBackend):
         (`tier.width`), so a tier that errors on the read keeps reporting
         its last known width instead of vanishing from the estimate."""
         width = 1
-        now = time.monotonic()
+        now = self._clock.now()
         for tier in self.tiers:
             with self._lock:
                 tripped = tier.state == _OPEN and (
@@ -464,7 +475,7 @@ class ResilientBackend(VerifyBackend):
     @property
     def active_tier(self) -> str:
         """First tier currently willing to take a call."""
-        now = time.monotonic()
+        now = self._clock.now()
         with self._lock:
             for tier in self.tiers:
                 if tier.state != _OPEN or (

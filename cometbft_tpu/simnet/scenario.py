@@ -81,6 +81,21 @@ def default_spec(**overrides) -> dict:
         "churn": [],  # [{"at_s", "down_s", "nodes"}] nodes = count, never node 0
         "tx_interval_s": 0.0,  # 0 = no load
         "txs_per_interval": 1,
+        # Byzantine actor windows (simnet/byzantine.py): [{"role":
+        # equivocator|withholder|flooder, "node", "from_s", "until_s",
+        # ...role knobs}]. Node 0 is never byzantine (hash reference).
+        "byzantine": [],
+        # In-sim blocksync late-joins: [{"node", "at_s"}] — the node is a
+        # genesis validator that stays dark until at_s, then catches up
+        # through real blocksync wire frames over the sim links and
+        # switches into consensus. Never node 0.
+        "joins": [],
+        # Background vote/evidence gossip tick (the reactor
+        # gossipVotesRoutine / evidence broadcast analogue): each tick a
+        # node relays to one rotating peer the votes that peer provably
+        # lacks at its own current round, plus any pending evidence.
+        # 0 disables (pre-round-19 behavior).
+        "gossip_interval_s": 1.0,
         "max_sim_s": 600.0,
         "watchdog_poll_s": 2.0,
         # Lower than the production default (10): sim recovery from a
@@ -105,7 +120,12 @@ def _synth_zone_latency(rng: random.Random, zones: int) -> list[list[float]]:
 
 
 class _SimNode:
-    __slots__ = ("index", "name", "cs", "mempool", "app", "online")
+    __slots__ = (
+        "index", "name", "cs", "mempool", "app", "online",
+        # Round 19: handles the blocksync late-join path needs to rebuild
+        # a caught-up ConsensusState, plus the node's evidence pool.
+        "cfg", "pv", "evpool", "executor", "block_store", "state_store",
+    )
 
     def __init__(self, index, name, cs, mempool, app):
         self.index = index
@@ -114,6 +134,12 @@ class _SimNode:
         self.mempool = mempool
         self.app = app
         self.online = True
+        self.cfg = None
+        self.pv = None
+        self.evpool = None
+        self.executor = None
+        self.block_store = None
+        self.state_store = None
 
 
 class Scenario:
@@ -142,8 +168,28 @@ class Scenario:
             "vote_dispatches": 0,
             "stall_fires": 0,
             "catchups": 0,
+            "conflicts_reported": 0,
+            "gossip_votes": 0,
+            "gossip_evidence": 0,
+            "evidence_rejects": 0,
+            "joins": 0,
+            "join_completions": 0,
+            "blocksync_served": 0,
         }
         self.schedule = {}  # realized schedule, filled by _build/_script
+        self.byz_actors: list = []
+        self._evidence_detections: list[dict] = []
+        self._commit_times: list[list] = []  # [height, sim_s] at node 0
+        # Gossip relay bookkeeping: per (i, j) the (height, sent-keys set)
+        # of votes already relayed, and a per-node rotor for peer choice.
+        self._gossip_sent: dict[tuple[int, int], tuple[int, set]] = {}
+        self._gossip_rotor: dict[int, int] = {}
+        # Blocksync late-join state per joining node index.
+        self._join_nodes: set[int] = {
+            int(j["node"]) for j in spec.get("joins", [])
+        }
+        self._join_state: dict[int, dict] = {}
+        self._join_reports: list[dict] = []
 
     # -- assembly -------------------------------------------------------------
 
@@ -152,6 +198,7 @@ class Scenario:
         from cometbft_tpu.config import test_config
         from cometbft_tpu.consensus.state import ConsensusState
         from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.evidence.pool import EvidencePool
         from cometbft_tpu.mempool import CListMempool
         from cometbft_tpu.proxy import AppConns, local_client_creator
         from cometbft_tpu.state import BlockExecutor, StateStore, make_genesis_state
@@ -206,8 +253,9 @@ class Scenario:
             state_store = StateStore(MemDB())
             block_store = BlockStore(MemDB())
             state_store.save(state)
+            evpool = EvidencePool(MemDB(), state_store, block_store)
             executor = BlockExecutor(
-                state_store, conns.consensus, mempool, None, block_store
+                state_store, conns.consensus, mempool, evpool, block_store
             )
             sink = self._make_tock_sink(i)
             ticker = SimTicker(self.clock, sink)
@@ -217,6 +265,7 @@ class Scenario:
                 executor,
                 block_store,
                 mempool,
+                evpool=evpool,
                 wal=None,
                 ticker=ticker,
                 clock=self.clock,
@@ -226,8 +275,36 @@ class Scenario:
             cs._stall_factor = float(spec["stall_factor"])
             cs.set_broadcast(self._make_broadcast(i))
             node = _SimNode(i, f"sim{i}", cs, mempool, app)
+            node.cfg = cfg
+            node.pv = pv
+            node.evpool = evpool
+            node.executor = executor
+            node.block_store = block_store
+            node.state_store = state_store
+            self._tap_conflict_reports(i, evpool)
             cs.set_on_stall(self._make_on_stall(node))
             self.nodes.append(node)
+
+        # Byzantine actors wrap the node's OWN broadcast (same send
+        # surface, no consensus-code forks — see simnet/byzantine.py).
+        from cometbft_tpu.simnet.byzantine import make_actor
+
+        for entry in spec["byzantine"]:
+            actor = make_actor(self, entry)
+            if actor.node_index in self._join_nodes:
+                raise ValueError(
+                    "a byzantine node cannot also be a late-joiner"
+                )
+            bnode = self.nodes[actor.node_index]
+            bnode.cs.set_broadcast(actor.wrap(bnode.cs._broadcast))
+            self.byz_actors.append(actor)
+        for j in sorted(self._join_nodes):
+            if not (1 <= j < self.n):
+                raise ValueError(
+                    f"join node must be in 1..{self.n - 1} "
+                    "(node 0 is the hash-reference node)"
+                )
+            self.nodes[j].online = False
 
         self.schedule = {
             "seed": self.seed,
@@ -249,7 +326,31 @@ class Scenario:
             },
             "partitions": [],
             "churn": [],
+            "byzantine": [a.resolved() for a in self.byz_actors],
+            "joins": [
+                {"node": int(j["node"]), "at_s": float(j["at_s"])}
+                for j in spec["joins"]
+            ],
+            "gossip_interval_s": float(spec["gossip_interval_s"]),
         }
+
+    def _tap_conflict_reports(self, i: int, evpool) -> None:
+        """Timestamp every conflicting-vote report (the evidence DETECTION
+        moment) so the report can bound detection→commitment latency."""
+        orig = evpool.report_conflicting_votes
+
+        def report(vote_a, vote_b):
+            self.counters["conflicts_reported"] += 1
+            self._evidence_detections.append({
+                "node": i,
+                "height": vote_a.height,
+                "round": vote_a.round,
+                "validator_index": vote_a.validator_index,
+                "sim_s": round(self.clock.now(), 6),
+            })
+            orig(vote_a, vote_b)
+
+        evpool.report_conflicting_votes = report
 
     # -- event plumbing -------------------------------------------------------
 
@@ -297,13 +398,20 @@ class Scenario:
                     bc(VoteMessage(own))
         return on_stall
 
+    # Heights served per catchup fire: one height per fire cannot close a
+    # growing gap (the chain advances ~1 height per commit dwell while the
+    # watchdog fires every poll × stall budget) — a blocksync late-joiner
+    # handed off one block behind tip would trail forever. A span bounds
+    # the burst while converging in O(gap / span) fires.
+    _CATCHUP_SPAN = 20
+
     def _catchup(self, node: _SimNode) -> None:
         """Consensus-reactor catchup-gossip analogue: a peer that already
-        committed this node's current height re-sends that height's
-        precommits (from its seen commit) and block parts. The precommits
-        arrive first so the 2/3-majority path re-creates the PartSet from
-        the committed block_id, then the parts complete it and the node
-        finalizes — exactly the lagging-peer flow of reactor.go."""
+        committed this node's current height re-sends, for a span of the
+        node's missing heights, each height's precommits (from its seen
+        commit) and block parts. The link FIFO keeps the span ordered, so
+        the node commits height h between the h and h+1 deliveries —
+        exactly the lagging-peer flow of reactor.go, span-batched."""
         from cometbft_tpu.consensus.messages import BlockPartMessage, VoteMessage
         from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
 
@@ -316,30 +424,34 @@ class Scenario:
         )
         if donor is None:
             return
-        seen = donor.cs.block_store.load_seen_commit(h)
-        block = donor.cs.block_store.load_block(h)
-        if seen is None or block is None:
-            return
-        self.counters["catchups"] += 1
-        msgs = []
-        for idx, sig in enumerate(seen.signatures):
-            if sig.is_absent():
-                continue
-            msgs.append(VoteMessage(Vote(
-                type=PRECOMMIT_TYPE,
-                height=seen.height,
-                round=seen.round,
-                block_id=sig.block_id(seen.block_id),
-                timestamp=sig.timestamp,
-                validator_address=sig.validator_address,
-                validator_index=idx,
-                signature=sig.signature,
-            )))
-        parts = block.make_part_set()
-        for k in range(parts.total):
-            msgs.append(BlockPartMessage(h, seen.round, parts.get_part(k)))
-        for msg in msgs:
-            self._send_direct(donor.index, node.index, msg)
+        served = False
+        for hh in range(h, min(donor.cs.rs.height, h + self._CATCHUP_SPAN)):
+            seen = donor.cs.block_store.load_seen_commit(hh)
+            block = donor.cs.block_store.load_block(hh)
+            if seen is None or block is None:
+                break
+            served = True
+            msgs = []
+            for idx, sig in enumerate(seen.signatures):
+                if sig.is_absent():
+                    continue
+                msgs.append(VoteMessage(Vote(
+                    type=PRECOMMIT_TYPE,
+                    height=seen.height,
+                    round=seen.round,
+                    block_id=sig.block_id(seen.block_id),
+                    timestamp=sig.timestamp,
+                    validator_address=sig.validator_address,
+                    validator_index=idx,
+                    signature=sig.signature,
+                )))
+            parts = block.make_part_set()
+            for k in range(parts.total):
+                msgs.append(BlockPartMessage(hh, seen.round, parts.get_part(k)))
+            for msg in msgs:
+                self._send_direct(donor.index, node.index, msg)
+        if served:
+            self.counters["catchups"] += 1
 
     def _send_direct(self, i: int, j: int, msg) -> None:
         due = max(
@@ -471,12 +583,16 @@ class Scenario:
                 {"at_s": at, "heal_s": heal, "fraction": frac,
                  "group_sizes": [k, self.n - k]}
             )
+        # Node 0 is the reference node for hashes: never churn it. Join
+        # nodes are dark until their at_s — churning one would double-book
+        # its online flag. With no joins this is identical sampling.
+        churnable = [i for i in range(1, self.n) if i not in self._join_nodes]
         for c in spec["churn"]:
             at = float(c["at_s"])
             down = float(c["down_s"])
-            count = min(int(c.get("nodes", 1)), max(self.n // 3 - 1, 0))
-            # Node 0 is the reference node for hashes: never churn it.
-            picked = self.rng.sample(range(1, self.n), count) if count else []
+            count = min(int(c.get("nodes", 1)), max(self.n // 3 - 1, 0),
+                        len(churnable))
+            picked = self.rng.sample(churnable, count) if count else []
             for idx in picked:
                 self.clock.timer(at, self._set_online, idx, False)
                 self.clock.timer(at + down, self._set_online, idx, True)
@@ -489,6 +605,16 @@ class Scenario:
         if poll > 0:
             for i in range(self.n):
                 self.clock.timer(poll, self._watchdog_tick, i)
+        gossip = float(spec["gossip_interval_s"])
+        if gossip > 0:
+            for i in range(self.n):
+                # Staggered first ticks: node i's gossip phase is offset so
+                # N nodes do not all relay on the same clock instant.
+                self.clock.timer(gossip * (1.0 + i / self.n), self._gossip_tick, i)
+        for j in spec["joins"]:
+            self.clock.timer(float(j["at_s"]), self._begin_join, int(j["node"]))
+        for actor in self.byz_actors:
+            actor.start()
 
     def _set_partition(self, groups) -> None:
         self._groups = groups
@@ -532,6 +658,267 @@ class Scenario:
             self._drain(node)
         self.clock.timer(float(self.spec["watchdog_poll_s"]), self._watchdog_tick, i)
 
+    # -- background gossip (votes + evidence) ---------------------------------
+    #
+    # The reactor-analogue the byzantine layer leans on: the per-signer
+    # broadcast alone never places two CONFLICTING copies of a vote in one
+    # honest node's VoteSet (each camp only ever saw its own copy), so
+    # equivocation would go undetected and pending evidence would only
+    # commit when the detecting node itself proposes. Each gossip tick a
+    # node picks one rotating same-height peer and relays (a) the votes it
+    # holds at that peer's CURRENT round which the peer provably lacks or
+    # holds a DIFFERENT copy of — the HasVote-bitmap logic of
+    # gossipVotesRoutine, with harness omniscience standing in for the
+    # tracked peer state — and (b) its pending evidence as real
+    # evidence-reactor wire bytes. In a healthy full mesh every vote is
+    # already at every peer, so (a) relays almost nothing; after a heal or
+    # under equivocation it converges the split knowledge within ticks.
+
+    def _gossip_tick(self, i: int) -> None:
+        node = self.nodes[i]
+        if node.online and node.cs is not None:
+            self._relay_votes(i)
+            self._relay_evidence(i)
+        self.clock.timer(float(self.spec["gossip_interval_s"]), self._gossip_tick, i)
+
+    def _gossip_peer(self, i: int) -> int | None:
+        h = self.nodes[i].cs.rs.height
+        candidates = [
+            j for j in range(self.n)
+            if j != i and self.nodes[j].online and self.nodes[j].cs is not None
+            and self.nodes[j].cs.rs.height == h and self._reachable(i, j)
+        ]
+        if not candidates:
+            return None
+        rotor = self._gossip_rotor.get(i, 0)
+        self._gossip_rotor[i] = rotor + 1
+        return candidates[rotor % len(candidates)]
+
+    def _relay_votes(self, i: int, cap: int = 16) -> None:
+        from cometbft_tpu.consensus.messages import VoteMessage
+
+        j = self._gossip_peer(i)
+        if j is None:
+            return
+        cs_i, cs_j = self.nodes[i].cs, self.nodes[j].cs
+        h, r_j = cs_i.rs.height, cs_j.rs.round
+        sent_h, sent = self._gossip_sent.get((i, j), (None, None))
+        if sent_h != h:
+            sent = set()
+            self._gossip_sent[(i, j)] = (h, sent)
+        relayed = 0
+        for vs_i, vs_j in (
+            (cs_i.rs.votes.prevotes(r_j), cs_j.rs.votes.prevotes(r_j)),
+            (cs_i.rs.votes.precommits(r_j), cs_j.rs.votes.precommits(r_j)),
+        ):
+            if vs_i is None:
+                continue
+            for idx, vote in enumerate(vs_i.votes):
+                if vote is None or relayed >= cap:
+                    continue
+                key = (r_j, vote.type, idx)
+                if key in sent:
+                    continue
+                theirs = vs_j.votes[idx] if vs_j is not None else None
+                if theirs is not None and theirs.block_id == vote.block_id:
+                    continue  # peer already holds this copy (HasVote)
+                sent.add(key)
+                relayed += 1
+                self.counters["gossip_votes"] += 1
+                self._send_direct(i, j, VoteMessage(vote))
+
+    def _relay_evidence(self, i: int, cap: int = 4) -> None:
+        from cometbft_tpu.evidence.reactor import encode_evidence_list_msg
+
+        j = self._gossip_peer(i)
+        if j is None:
+            return
+        evpool = self.nodes[i].evpool
+        if evpool is None:
+            return
+        pending, _ = evpool.pending_evidence(-1)
+        if not pending:
+            return
+        raw = encode_evidence_list_msg(pending[:cap])
+        self.counters["gossip_evidence"] += 1
+        self.clock.timer(self._link_delay(i, j), self._deliver_evidence, j, raw)
+
+    def _deliver_evidence(self, j: int, raw: bytes) -> None:
+        from cometbft_tpu.evidence.reactor import decode_evidence_list_msg
+
+        node = self.nodes[j]
+        if not node.online or node.evpool is None:
+            self.counters["offline_skips"] += 1
+            return
+        for ev in decode_evidence_list_msg(raw):
+            try:
+                node.evpool.add_evidence(ev)
+            except Exception:
+                # Peers that have not yet committed the evidence height
+                # reject it (evidence/reactor.go swallows the same way);
+                # the sender keeps re-offering while it stays pending.
+                self.counters["evidence_rejects"] += 1
+
+    # -- in-sim blocksync late-join -------------------------------------------
+    #
+    # A join node is a genesis validator that stays dark until ``at_s``,
+    # then catches up by driving REAL blocksync wire frames
+    # (encode_block_request/encode_block_response + the
+    # verify_commit_light-then-apply flow of blocksync/reactor.py
+    # _try_sync_one) over the sim link model, and finally constructs a
+    # fresh ConsensusState from the synced state — the same boot sequence
+    # a wall-clock node performs, minus the thread-driven reactor shell
+    # that would break single-threaded determinism.
+
+    _JOIN_WINDOW = 8  # request pipeline depth (blocksync pool analogue)
+    _JOIN_POLL_S = 0.5
+
+    def _begin_join(self, j: int) -> None:
+        self.counters["joins"] += 1
+        self._join_state[j] = {
+            "blocks": {},
+            "requested": set(),
+            "state": self.nodes[j].state_store.load(),
+            "synced": 0,
+            "started_s": round(self.clock.now(), 6),
+            "done": False,
+        }
+        self._blocksync_tick(j)
+
+    def _pick_donor(self, j: int):
+        best = None
+        for d in self.nodes:
+            if (
+                d.index == j or not d.online or d.cs is None
+                or not self._reachable(d.index, j)
+            ):
+                continue
+            h = d.block_store.height()
+            if h > 0 and (best is None or h > best.block_store.height()):
+                best = d
+        return best
+
+    def _blocksync_tick(self, j: int) -> None:
+        from cometbft_tpu.blocksync.reactor import encode_block_request
+
+        js = self._join_state.get(j)
+        if js is None or js["done"]:
+            return
+        node = self.nodes[j]
+        donor = self._pick_donor(j)
+        if donor is not None:
+            tip = donor.block_store.height()
+            my_h = node.block_store.height()
+            if my_h >= tip - 1:
+                # Within one block of the donor tip: the pair rule cannot
+                # certify the tip block, so switch to consensus — the
+                # watchdog catchup path serves the remainder, exactly the
+                # reactor's is_caught_up handoff.
+                self._complete_join(j, js)
+                return
+            for h in range(my_h + 1, min(my_h + 1 + self._JOIN_WINDOW, tip + 1)):
+                if h in js["blocks"] or h in js["requested"]:
+                    continue
+                js["requested"].add(h)
+                raw = encode_block_request(h)
+                self.clock.timer(
+                    self._link_delay(j, donor.index),
+                    self._bs_serve, donor.index, j, raw,
+                )
+        self.clock.timer(self._JOIN_POLL_S, self._blocksync_tick, j)
+
+    def _bs_serve(self, d: int, j: int, raw: bytes) -> None:
+        from cometbft_tpu.blocksync.reactor import (
+            decode_message,
+            encode_block_response,
+        )
+
+        donor = self.nodes[d]
+        if not donor.online:
+            return  # request lost: the joiner's next tick re-picks a donor
+        kind, height = decode_message(raw)
+        assert kind == "block_request"
+        block = donor.block_store.load_block(height)
+        if block is None:
+            return
+        self.counters["blocksync_served"] += 1
+        self.clock.timer(
+            self._link_delay(d, j), self._bs_receive, j,
+            encode_block_response(block),
+        )
+
+    def _bs_receive(self, j: int, raw: bytes) -> None:
+        from cometbft_tpu.blocksync.reactor import decode_message
+
+        js = self._join_state.get(j)
+        if js is None or js["done"]:
+            return
+        kind, block = decode_message(raw)
+        assert kind == "block_response"
+        h = block.header.height
+        js["blocks"][h] = block
+        js["requested"].discard(h)
+        self._bs_apply(j, js)
+
+    def _bs_apply(self, j: int, js: dict) -> None:
+        """reactor.py _try_sync_one verbatim: verify `first` with
+        `second.last_commit` (verify_commit_light — the TPU-batched call),
+        validate, save with the certifying commit, apply."""
+        from cometbft_tpu.types.block import BlockID
+
+        node = self.nodes[j]
+        while True:
+            h = node.block_store.height() + 1
+            first, second = js["blocks"].get(h), js["blocks"].get(h + 1)
+            if first is None or second is None:
+                return
+            first_parts = first.make_part_set()
+            first_id = BlockID(first.hash(), first_parts.header())
+            state = js["state"]
+            state.validators.verify_commit_light(
+                state.chain_id, first_id, h, second.last_commit
+            )
+            node.executor.validate_block(state, first)
+            node.block_store.save_block(first, first_parts, second.last_commit)
+            js["state"], _ = node.executor.apply_block(state, first_id, first)
+            del js["blocks"][h]
+            js["synced"] += 1
+
+    def _complete_join(self, j: int, js: dict) -> None:
+        from cometbft_tpu.consensus.state import ConsensusState
+
+        node = self.nodes[j]
+        js["done"] = True
+        sink = self._make_tock_sink(j)
+        cs = ConsensusState(
+            node.cfg.consensus,
+            js["state"],
+            node.executor,
+            node.block_store,
+            node.mempool,
+            evpool=node.evpool,
+            wal=None,
+            ticker=SimTicker(self.clock, sink),
+            clock=self.clock,
+            name=node.name,
+        )
+        cs.set_priv_validator(node.pv)
+        cs._stall_factor = float(self.spec["stall_factor"])
+        cs.set_broadcast(self._make_broadcast(j))
+        node.cs = cs
+        cs.set_on_stall(self._make_on_stall(node))
+        node.online = True
+        cs.ticker.start()
+        cs._schedule_round0()
+        self.counters["join_completions"] += 1
+        self._join_reports.append({
+            "node": j,
+            "started_s": js["started_s"],
+            "joined_s": round(self.clock.now(), 6),
+            "synced_blocks": js["synced"],
+            "height_at_join": node.block_store.height(),
+        })
+
     # -- run ------------------------------------------------------------------
 
     def run(self) -> dict:
@@ -569,15 +956,26 @@ class Scenario:
             self._build()
             self._script()
             for node in self.nodes:
+                if node.index in self._join_nodes:
+                    continue  # dark until its join event fires
                 node.cs.ticker.start()
                 node.cs._schedule_round0()
             cs0 = self.nodes[0].cs
+            last_h = cs0.rs.height
             while (
                 cs0.rs.height < target_height
                 and self.clock.now() < horizon
                 and self.clock.step()
             ):
-                pass
+                if cs0.rs.height != last_h:
+                    t = round(self.clock.now(), 6)
+                    for hh in range(last_h, cs0.rs.height):
+                        self._commit_times.append([hh, t])
+                    last_h = cs0.rs.height
+            if cs0.rs.height != last_h:  # the commit that ended the loop
+                t = round(self.clock.now(), 6)
+                for hh in range(last_h, cs0.rs.height):
+                    self._commit_times.append([hh, t])
         finally:
             cmttime.set_now_source(None)
             if prev_window is None:
@@ -619,8 +1017,9 @@ class Scenario:
                 blk = node.cs.block_store.load_block(common)
                 if blk is None or blk.hash().hex() != agreed_hash:
                     agreement = False
+        safety_ok, conflicting = self._check_safety(committed)
         return {
-            "ok": reached and agreement,
+            "ok": reached and agreement and safety_ok,
             "seed": self.seed,
             "validators": self.n,
             "blocks_target": int(self.spec["blocks"]),
@@ -634,12 +1033,127 @@ class Scenario:
             "agreed_height": common,
             "agreed_hash": agreed_hash,
             "hash_agreement": agreement,
+            "safety_ok": safety_ok,
+            "conflicting_heights": conflicting,
+            "evidence": self._evidence_report(committed),
+            "recovery": self._recovery_report(),
+            "joins": list(self._join_reports),
+            "commit_times": [list(x) for x in self._commit_times],
             "sim_time_s": round(sim_time, 6),
             "wall_time_s": round(wall, 6),
             "accel": round(sim_time / wall, 3) if wall > 0 else None,
             "events": self.clock.events_run,
             "counters": dict(self.counters),
             "schedule": self.schedule,
+        }
+
+    # -- report helpers -------------------------------------------------------
+
+    def _check_safety(self, committed: int) -> tuple[bool, list[int]]:
+        """The BFT safety contract: no two HONEST nodes hold different
+        blocks at any committed height (byzantine nodes' own stores are
+        not part of the claim). Distinct from hash_agreement, which only
+        checks the highest common height."""
+        byz = {a.node_index for a in self.byz_actors}
+        conflicting = []
+        for h in range(1, committed + 1):
+            seen = None
+            for node in self.nodes:
+                if node.index in byz:
+                    continue
+                meta = node.cs.block_store.load_block_meta(h)
+                if meta is None:
+                    continue
+                bh = meta.block_id.hash
+                if seen is None:
+                    seen = bh
+                elif bh != seen:
+                    conflicting.append(h)
+                    break
+        return not conflicting, conflicting
+
+    def _evidence_report(self, committed: int) -> dict:
+        """Detection → pending → committed accounting, from node 0's chain
+        (every honest chain is bit-identical when safety holds)."""
+        committed_heights = []
+        committed_count = 0
+        for h in range(1, committed + 1):
+            blk = self.nodes[0].cs.block_store.load_block(h)
+            if blk is not None and blk.evidence:
+                committed_heights.append(h)
+                committed_count += len(blk.evidence)
+        byz = {a.node_index for a in self.byz_actors}
+        pending_honest = 0
+        pool_stats: dict[str, int] = {}
+        for node in self.nodes:
+            if node.index in byz or node.evpool is None:
+                continue
+            snap = node.evpool.stats_snapshot()
+            pending_honest = max(pending_honest, snap["pending"])
+            for k, v in snap.items():
+                pool_stats[k] = pool_stats.get(k, 0) + v
+        first = self._evidence_detections[0] if self._evidence_detections else None
+        commit_s = None
+        if committed_heights:
+            at = dict((hh, t) for hh, t in self._commit_times)
+            commit_s = at.get(committed_heights[0])
+        return {
+            "detections": len(self._evidence_detections),
+            "first_detection": first,
+            "committed_heights": committed_heights,
+            "committed_count": committed_count,
+            "first_commit_sim_s": commit_s,
+            "detect_to_commit_s": (
+                round(commit_s - first["sim_s"], 6)
+                if commit_s is not None and first is not None else None
+            ),
+            "max_pending_honest": pending_honest,
+            "pool_stats": pool_stats,
+        }
+
+    def _recovery_report(self) -> dict:
+        """Block-rate recovery after the last byzantine/partition window:
+        baseline = median commit interval during clean time before the
+        first window; recovered when a post-window commit interval is
+        back within 2x baseline."""
+        disturb_from = [float(a.from_s) for a in self.byz_actors]
+        disturb_until = [float(a.until_s) for a in self.byz_actors]
+        for p in self.schedule.get("partitions", []):
+            disturb_from.append(float(p["at_s"]))
+            disturb_until.append(float(p["heal_s"]))
+        if not disturb_from or len(self._commit_times) < 3:
+            return {"applicable": False}
+        t_from, t_until = min(disturb_from), max(disturb_until)
+        ct = self._commit_times
+        intervals = [
+            (ct[k][1], ct[k][1] - ct[k - 1][1]) for k in range(1, len(ct))
+        ]
+        base = sorted(dt for t, dt in intervals if t <= t_from)
+        source = "pre_window"
+        if not base:
+            # Nothing committed before the window opened (early
+            # disturbance): take the run's steady-state tail instead —
+            # the last quartile of intervals — as the honest baseline.
+            tail = [dt for _, dt in intervals[-max(2, len(intervals) // 4):]]
+            base = sorted(tail)
+            source = "tail"
+        baseline = base[len(base) // 2] if base else None
+        recovered_at = None
+        if baseline:
+            for t, dt in intervals:
+                if t > t_until and dt <= 2.0 * baseline:
+                    recovered_at = t
+                    break
+        return {
+            "applicable": True,
+            "baseline_source": source,
+            "baseline_interval_s": round(baseline, 6) if baseline else None,
+            "window": [t_from, t_until],
+            "recovered_at_s": recovered_at,
+            "recovery_lag_s": (
+                round(recovered_at - t_until, 6)
+                if recovered_at is not None else None
+            ),
         }
 
 

@@ -142,7 +142,9 @@ class SimNetwork:
         # last scheduled delivery time (FIFO clamp under jitter).
         self._busy_until: dict[tuple[str, str], float] = {}
         self._last_delivery: dict[tuple[str, str], float] = {}
-        self.stats = {"delivered": 0, "dropped": 0, "partitioned": 0}
+        # Per-source adversarial send taps (see set_send_tap).
+        self._send_taps: dict[str, object] = {}
+        self.stats = {"delivered": 0, "dropped": 0, "partitioned": 0, "tapped": 0}
 
     # -- topology scripting --------------------------------------------------
 
@@ -180,12 +182,40 @@ class SimNetwork:
             p.update(self._link_overrides.get(frozenset((a_id, b_id)), {}))
             return p
 
+    def set_send_tap(self, node_id: str, fn) -> None:
+        """Install an adversarial tap on every write ``node_id`` makes.
+
+        ``fn(dst_id, data)`` returns ``None`` to pass the write through
+        untouched, or a list of ``(extra_delay_s, payload)`` replacements:
+        ``[]`` drops the write, one entry delays/rewrites it, several
+        duplicate it. Taps operate on whole ``write()`` calls — one framed
+        MConnection packet — so a byzantine tap can reorder/replay/withhold
+        *packets* without ever desyncing a stream (same granularity as the
+        link drop model). ``fn=None`` removes the tap.
+        """
+        with self._mtx:
+            if fn is None:
+                self._send_taps.pop(node_id, None)
+            else:
+                self._send_taps[node_id] = fn
+
     # -- wire ----------------------------------------------------------------
 
     def _transmit(self, src: SimConn, data: bytes) -> None:
         dst = src.peer
         if dst is None:
             raise ConnectionError("unpaired conn")
+        tap = self._send_taps.get(src.local_id)
+        if tap is not None:
+            plan = tap(src.remote_id, data)
+            if plan is not None:
+                self.stats["tapped"] += 1
+                for extra_delay, payload in plan:
+                    self._schedule(src, dst, bytes(payload), float(extra_delay))
+                return
+        self._schedule(src, dst, data, 0.0)
+
+    def _schedule(self, src: SimConn, dst: SimConn, data: bytes, extra_delay: float) -> None:
         with self._mtx:
             if not self.reachable(src.local_id, src.remote_id):
                 self.stats["partitioned"] += 1
@@ -196,7 +226,7 @@ class SimNetwork:
                 return
             now = self.clock.now()
             key = (src.local_id, src.remote_id)
-            delay = p["latency_s"]
+            delay = p["latency_s"] + extra_delay
             if p["jitter_s"] > 0:
                 delay += self._rng.uniform(0.0, p["jitter_s"])
             if p["bandwidth_bps"] > 0:

@@ -248,12 +248,18 @@ def test_wedged_tier_costs_one_deadline_not_liveness():
     assert c["deadline_exceeded"] == 1 and c["degraded_calls"] == 1
 
     # Second call: the wedged worker is still busy -> fail fast, trip.
+    # "Fast" here means NO deadline wait happened (TierWedged short-circuits
+    # before the worker), not a tight wall bound: on this 1-core CI host the
+    # 10,240-signature anchor pass alone can take ~200 ms under suite load,
+    # so the wall assertion only rules out another full deadline spent
+    # waiting on the wedge (the counters are the primary signal).
     t0 = time.perf_counter()
     ok, _ = sup.batch_verify(pubs, msgs, sigs)
     fast_ms = (time.perf_counter() - t0) * 1000
     assert ok
-    assert fast_ms < deadline_ms / 2, f"post-wedge call took {fast_ms:.0f} ms"
+    assert fast_ms < deadline_ms, f"post-wedge call took {fast_ms:.0f} ms"
     c = sup.counters()
+    assert c["deadline_exceeded"] == 1  # still just the first call's
     assert c["trips"] == 1 and c["active_tier"] == "cpu"
 
 
@@ -262,9 +268,15 @@ def test_no_deadline_means_inline_calls():
     primary = _ScriptedBackend()
     primary.failing = False
     sup = _supervisor(primary, deadline_ms=0)
+    # Delta, not absolute: the full suite leaks daemon threads from other
+    # modules (indexer pumps, sidecar servers), so an absolute
+    # active_count() bound flakes by test ordering. The claim under test
+    # is only that deadline_ms=0 spawns NO tier workers.
+    before = threading.active_count()
     ok, _ = sup.batch_verify(pubs, msgs, sigs)
     assert ok
-    assert threading.active_count() < 50  # no worker thread explosion
+    assert threading.active_count() - before == 0  # inline: no tier workers
+    assert all(t.worker._thread is None for t in sup.tiers)
 
 
 # -- cross-check ---------------------------------------------------------------

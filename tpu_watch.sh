@@ -122,6 +122,13 @@ parts.append(
     f"{rq['demux_p95_ms']}ms"
     + (" order-identical" if rq.get("order_identical") else "")
     if rq else "recvq absent")
+bz = rec.get("stages", {}).get("byz")
+parts.append(
+    f"byz ev-commit {bz.get('equivocator_detect_to_commit_s')}sim-s "
+    f"rate {bz.get('block_rate_equivocator_ratio')}/"
+    f"{bz.get('block_rate_vote_flood_ratio')}"
+    + ("" if bz.get("equivocator_safety_ok") else " SAFETY-FAIL")
+    if bz else "byz absent")
 print("; ".join(parts))
 PYEOF
       )
