@@ -1913,6 +1913,249 @@ def _lightgw_stage(stages: dict, plog) -> None:
         _be.set_backend(old_backend)
 
 
+def _bundle_stage(stages: dict, plog) -> None:
+    """Checkpoint bundles (ISSUE 20): N clients cold-sync to a checkpoint,
+    one shared cached bundle vs per-client gateway proofs vs per-client
+    bisection.
+
+    Every interaction with the origin node is billed one simulated RTT
+    (CMTPU_BENCH_BUNDLE_RTT_MS, default 20) and its wire bytes counted.
+    The trust anchor (height 1) ships in client config — no arm pays for
+    it.  Arm `bundle`: the FIRST client pulls the checkpoint artifact; the
+    rest read a dumb shared cache (content addressing is what makes that
+    cache safe), and the target light block rides inside the bundle — one
+    origin round trip for the whole swarm.  Arm `gateway_proof`: each
+    client fetches the target AND calls light_proof.  Arm `bisection`:
+    each client fetches the target and bisects (no-rotation chain: the
+    1 -> target hop verifies directly, so this is the floor the bundle
+    trace must be bit-identical to).  The stage asserts the acceptance
+    bar: >= 3x fewer origin round trips AND >= 3x fewer total wire bytes
+    than the gateway-proof arm, with bundle-arm trust decisions (stored
+    trace heights + hashes) bit-identical to plain bisection."""
+    import threading as _threading
+
+    from cometbft_tpu.libs.db import MemDB
+    from cometbft_tpu.light.bundle import Bundle
+    from cometbft_tpu.light.client import Client, TrustOptions
+    from cometbft_tpu.light.gateway import LightGateway
+    from cometbft_tpu.light.origin import BundleOrigin
+    from cometbft_tpu.light.provider import MockProvider
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.types import Time as _Time
+
+    n_clients = int(os.environ.get("CMTPU_BENCH_BUNDLE_CLIENTS", "8"))
+    height = int(os.environ.get("CMTPU_BENCH_BUNDLE_HEIGHT", "120"))
+    interval = int(os.environ.get("CMTPU_BENCH_BUNDLE_INTERVAL", str(height)))
+    rtt_ms = float(os.environ.get("CMTPU_BENCH_BUNDLE_RTT_MS", "20"))
+
+    chain = _LazyChain(n_vals=32, rotate=0, heights=height)
+    lb1 = chain.light_block(1)
+    now = lambda: _Time(1700000000 + 10 * height + 600, 0)
+    opts = TrustOptions(
+        period_ns=365 * 24 * 3600 * 10**9, height=1, hash=lb1.hash()
+    )
+
+    origin = BundleOrigin(chain.CHAIN_ID, chain.provider(), interval=interval)
+    t0 = time.perf_counter()
+    bname, bdata, boundary = origin.get_encoded(0)
+    build_ms = (time.perf_counter() - t0) * 1000
+    anchor = Bundle.decode(bdata).anchor
+    plog(
+        f"bundle fixture built: checkpoint {boundary}, {len(bdata)} B "
+        f"({build_ms:.0f} ms origin-side build)"
+    )
+
+    class _Meter:
+        """One origin round trip = one billed RTT + the bytes shipped."""
+
+        def __init__(self):
+            self.trips = 0
+            self.bytes = 0
+            self._lock = _threading.Lock()
+
+        def bill(self, nbytes):
+            with self._lock:
+                self.trips += 1
+                self.bytes += nbytes
+            if rtt_ms > 0:
+                time.sleep(rtt_ms / 1000.0)
+
+    class _RemoteProvider:
+        """Height 1 is the baked-in trust root (free); everything else is
+        an origin round trip."""
+
+        def __init__(self, meter):
+            self._meter = meter
+
+        def chain_id(self):
+            return chain.CHAIN_ID
+
+        def light_block(self, h):
+            lb = chain.light_block(h if h else boundary)
+            if lb.height != 1:
+                self._meter.bill(len(lb.encode()))
+            return lb
+
+        def report_evidence(self, ev):
+            pass
+
+    class _RemoteGateway:
+        def __init__(self, gw, meter):
+            self._gw = gw
+            self._meter = meter
+
+        def prove(self, height_, anchor_height=0):
+            resp = self._gw.prove(height_, anchor_height=anchor_height)
+            self._meter.bill(int(resp.get("bytes", 0)))
+            return resp
+
+        def plan(self, *a, **kw):
+            resp = self._gw.plan(*a, **kw)
+            self._meter.bill(0)
+            return resp
+
+    class _CachedSource:
+        """The CDN edge: one origin pull, then every client reads the
+        content-addressed blob locally."""
+
+        def __init__(self, meter):
+            self._meter = meter
+            self._lock = _threading.Lock()
+            self._data = None
+
+        def bundle(self, height_=0):
+            with self._lock:
+                if self._data is None:
+                    _, data, _ = origin.get_encoded(height_)
+                    self._meter.bill(len(data))
+                    self._data = data
+            return self._data
+
+    def _swarm(make_client):
+        times: list = [0.0] * n_clients
+        stores: list = [None] * n_clients
+        errors: list = []
+        start = _threading.Barrier(n_clients + 1)
+
+        def _run(i):
+            try:
+                start.wait()
+                t1 = time.perf_counter()
+                c = make_client()
+                assert c.verify_light_block_at_height(
+                    boundary, now=now()
+                ).height == boundary
+                times[i] = (time.perf_counter() - t1) * 1000
+                stores[i] = c
+            except Exception as e:  # pragma: no cover - stage must report
+                errors.append(e)
+
+        threads = [
+            _threading.Thread(target=_run, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t1 = time.perf_counter()
+        for t in threads:
+            t.join(300.0)
+        wall = (time.perf_counter() - t1) * 1000
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in threads):
+            raise RuntimeError("bundle swarm client still running after 300s")
+        return times, wall, stores
+
+    p95 = lambda xs: sorted(xs)[max(0, int(0.95 * (len(xs) - 1)))]
+
+    def _arm(meter, times, wall):
+        return {
+            "origin_round_trips": meter.trips,
+            "wire_bytes": meter.bytes,
+            "p95_ms": round(p95(times), 2),
+            "wall_ms": round(wall, 2),
+        }
+
+    # -- arm A: per-client local bisection (the reference decision) --
+    m_bis = _Meter()
+    times, wall, clients = _swarm(lambda: Client(
+        chain.CHAIN_ID, opts, _RemoteProvider(m_bis), [], LightStore(MemDB()),
+    ))
+    arm_bis = _arm(m_bis, times, wall)
+    ref = clients[0]
+    ref_trace = {
+        h: ref.store.light_block(h).hash() for h in ref.store._heights()
+    }
+
+    # -- arm B: per-client gateway MMR proofs --
+    gw = LightGateway(chain.CHAIN_ID, chain.provider())
+    m_gw = _Meter()
+    times, wall, clients = _swarm(lambda: Client(
+        chain.CHAIN_ID, opts, _RemoteProvider(m_gw), [], LightStore(MemDB()),
+        gateway=_RemoteGateway(gw, m_gw), gateway_proofs=True,
+    ))
+    arm_gw = _arm(m_gw, times, wall)
+    for c in clients:
+        if c.gateway_stats["proof_syncs"] != 1:
+            raise RuntimeError("gateway arm client missed the proof path")
+
+    # -- arm C: one cached bundle for the whole swarm --
+    m_bun = _Meter()
+    src = _CachedSource(m_bun)
+    times, wall, clients = _swarm(lambda: Client(
+        chain.CHAIN_ID, opts,
+        MockProvider(chain.CHAIN_ID, {1: lb1, boundary: anchor}),
+        [], LightStore(MemDB()), bundle_source=src,
+    ))
+    arm_bun = _arm(m_bun, times, wall)
+    for c in clients:
+        if c.gateway_stats["bundle_syncs"] != 1 or \
+                c.gateway_stats["bundle_rejects"]:
+            raise RuntimeError("bundle arm client missed the bundle path")
+        got = {
+            h: c.store.light_block(h).hash() for h in c.store._heights()
+        }
+        if got != ref_trace:
+            raise RuntimeError(
+                "bundle trust decisions diverge from plain bisection"
+            )
+
+    trip_ratio = arm_gw["origin_round_trips"] / max(
+        arm_bun["origin_round_trips"], 1
+    )
+    bytes_ratio = arm_gw["wire_bytes"] / max(arm_bun["wire_bytes"], 1)
+    if trip_ratio < 3 or bytes_ratio < 3:
+        raise RuntimeError(
+            f"bundle arm below the 3x bar: trips {trip_ratio:.1f}x, "
+            f"bytes {bytes_ratio:.1f}x vs gateway proofs"
+        )
+    stages["bundle"] = {
+        "clients": n_clients,
+        "height": boundary,
+        "interval": interval,
+        "simulated_rtt_ms": rtt_ms,
+        "bundle_bytes": len(bdata),
+        "bundle_name": bname,
+        "origin_build_ms": round(build_ms, 1),
+        "arms": {
+            "bisection": arm_bis,
+            "gateway_proof": arm_gw,
+            "bundle": arm_bun,
+        },
+        "round_trips_vs_proof": round(trip_ratio, 1),
+        "wire_bytes_vs_proof": round(bytes_ratio, 1),
+        "trace_identical": True,
+    }
+    plog(
+        f"bundle: {n_clients} clients to {boundary}: "
+        f"{arm_bun['origin_round_trips']} origin trips / "
+        f"{arm_bun['wire_bytes']} B vs gateway "
+        f"{arm_gw['origin_round_trips']} / {arm_gw['wire_bytes']} B "
+        f"({trip_ratio:.0f}x trips, {bytes_ratio:.1f}x bytes), "
+        f"p95 {arm_bun['p95_ms']} vs {arm_gw['p95_ms']} ms"
+    )
+
+
 def agg_worker() -> None:
     """--agg-worker argv mode: the bn254 device multi-pairing arm in its own
     jax process (always pinned to JAX_PLATFORMS=cpu by the parent — the
@@ -2777,6 +3020,13 @@ def shipped_path_stages(stages: dict, plog, budget_left, backend: str) -> None:
             _lightgw_stage(stages, plog)
         except Exception as e:
             plog(f"lightgw stage failed: {type(e).__name__}: {e}")
+
+    # ---- checkpoint bundles: cached artifact vs proofs vs bisection ----
+    if budget_left():
+        try:
+            _bundle_stage(stages, plog)
+        except Exception as e:
+            plog(f"bundle stage failed: {type(e).__name__}: {e}")
 
     # ---- simnet: virtual-clock 100-node scenario, sim vs wall time ----
     if budget_left():

@@ -601,6 +601,79 @@ def _parse_seeds(spec: str) -> list[int]:
     return seeds
 
 
+def cmd_bundle(args) -> int:
+    """Checkpoint-bundle origin tooling (light/origin.py): export a
+    stopped node's data dir into the flat directory any dumb HTTP cache
+    replicates, serve such a directory, or verify one."""
+    sub = getattr(args, "bundle_cmd", None)
+    if sub == "export":
+        from cometbft_tpu.libs.db import new_db
+        from cometbft_tpu.light.origin import BundleOrigin
+        from cometbft_tpu.light.provider import BlockStoreProvider
+        from cometbft_tpu.state.store import StateStore
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types.genesis import GenesisDoc
+
+        cfg = _load_config(args.home)
+        doc = GenesisDoc.from_file(cfg.base.genesis_path())
+        db_dir = cfg.base.db_path()
+        block_store = BlockStore(new_db("blockstore", cfg.base.db_backend, db_dir))
+        state_store = StateStore(new_db("state", cfg.base.db_backend, db_dir))
+        origin = BundleOrigin(
+            doc.chain_id,
+            BlockStoreProvider(doc.chain_id, block_store, state_store),
+            interval=args.interval or None,
+            keep=args.keep or None,
+            state_path=os.path.join(db_dir, "light_mmr.state"),
+        )
+        index = origin.export(args.out)
+        print(json.dumps({"out": args.out, **index}, sort_keys=True))
+        return 0
+    if sub == "serve":
+        import functools
+        from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+        handler = functools.partial(
+            SimpleHTTPRequestHandler, directory=args.dir
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+        print(f"serving bundles from {args.dir} on "
+              f"http://127.0.0.1:{httpd.server_address[1]}")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            httpd.server_close()
+        return 0
+    if sub == "verify":
+        from cometbft_tpu.light.bundle import (
+            Bundle, BundleError, DirBundleSource, check_name,
+        )
+
+        src = DirBundleSource(args.dir)
+        idx = src._index()
+        bad = 0
+        for h, name in sorted(
+            idx.get("bundles", {}).items(), key=lambda kv: int(kv[0])
+        ):
+            try:
+                with open(os.path.join(args.dir, f"{name}.bundle"), "rb") as f:
+                    data = f.read()
+                check_name(name, data)
+                b = Bundle.decode(data)
+                b.self_check(idx.get("chain_id"))
+                if b.anchor.height != int(h):
+                    raise BundleError(
+                        f"indexed height {h} != anchor {b.anchor.height}"
+                    )
+                print(f"ok   {h:>10} {name[:16]}… {len(data)} bytes")
+            except (OSError, BundleError) as e:
+                bad += 1
+                print(f"BAD  {h:>10} {name[:16]}… {e}")
+        return 1 if bad else 0
+    print("bundle: expected export | serve | verify", file=sys.stderr)
+    return 1
+
+
 def cmd_e2e(args) -> int:
     """Manifest-driven e2e testnet runs (reference: test/e2e/runner +
     test/e2e/generator): run one manifest, generate a seeded random one,
@@ -721,6 +794,25 @@ def main(argv=None) -> int:
     sp.add_argument("--validators", type=int, default=4)
     sp.add_argument("--signed", action="store_true",
                     help="emit SignedTxEnvelopes through the QoS ingress")
+    sp = sub.add_parser("bundle")
+    bundle_sub = sp.add_subparsers(dest="bundle_cmd")
+    bp = bundle_sub.add_parser(
+        "export", help="export checkpoint bundles from a node data dir"
+    )
+    bp.add_argument("--out", required=True, help="flat output directory")
+    bp.add_argument("--interval", type=int, default=0,
+                    help="checkpoint interval (default CMTPU_BUNDLE_INTERVAL)")
+    bp.add_argument("--keep", type=int, default=0,
+                    help="newest checkpoints to export (default CMTPU_BUNDLE_KEEP)")
+    bp = bundle_sub.add_parser(
+        "serve", help="dumb HTTP file server over an exported directory"
+    )
+    bp.add_argument("--dir", required=True)
+    bp.add_argument("--port", type=int, default=0)
+    bp = bundle_sub.add_parser(
+        "verify", help="content-address + self-check every indexed bundle"
+    )
+    bp.add_argument("--dir", required=True)
     sp = sub.add_parser("e2e")
     # Flat flags keep `e2e --manifest m.toml` working; the nested
     # subcommands mirror the reference's runner/generator split.
@@ -766,6 +858,7 @@ def main(argv=None) -> int:
         "replay-console": lambda a: cmd_replay(a, console=True),
         "debug": cmd_debug,
         "loadtime": cmd_loadtime,
+        "bundle": cmd_bundle,
         "e2e": cmd_e2e,
     }
     if args.command is None:

@@ -54,7 +54,7 @@ _ABCI_SMALL = ("local",) * 7 + ("socket",) * 3
 _PERTURB_FULL = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
-    "light_gateway", "mixed_load", "recv_flood",
+    "light_gateway", "mixed_load", "recv_flood", "bundle_cold_sync",
 )
 # _PERTURB_SMALL is FROZEN: the matrix regression suite pins small-profile
 # seeds by number (the round-15 stall forensics and the round-18 un-pinned

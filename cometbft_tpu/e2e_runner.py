@@ -64,7 +64,7 @@ ABCI_MODES = ("local", "socket", "grpc")
 PERTURBATIONS = (
     "kill", "pause", "disconnect", "restart", "backend_faults",
     "concurrent_light_clients", "tx_flood", "vote_batch",
-    "light_gateway", "mixed_load", "recv_flood",
+    "light_gateway", "mixed_load", "recv_flood", "bundle_cold_sync",
 )
 BACKENDS = ("cpu", "hybrid")
 APPS = ("kvstore", "persistent_kvstore")
@@ -293,6 +293,10 @@ class E2ERunner:
         # Per-node results of the recv_flood perturbation (gossip-side
         # mempool flood pressuring the target's prioritized recv demux).
         self._recv_floods: dict[str, dict] = {}
+        # Per-node results of the bundle_cold_sync perturbation (checkpoint
+        # bundle exported live, swarm syncs from the flat dir with the
+        # origin node DOWN, then the node relaunches).
+        self._bundle_syncs: dict[str, dict] = {}
         # Stall forensics: every node's consensus round-state, captured at
         # the moment a wait_height deadline expires (the nodes are SIGKILLed
         # during teardown, so this is the only window to collect it).
@@ -470,6 +474,11 @@ class E2ERunner:
             # through one HTTP connection on a slow host (~20/s observed
             # single-core) and well over the honest cadence (~1 tx/s).
             env["CMTPU_INGRESS_SENDER_RPS"] = "4"
+        if "bundle_cold_sync" in node.perturb:
+            # Checkpoint every 2 blocks so a short e2e run crosses several
+            # boundaries — the default 1000 would never checkpoint here.
+            # Armed from genesis (the perturb list is known up front).
+            env["CMTPU_BUNDLE_INTERVAL"] = "2"
         return subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cmd", "--home",
              os.path.join(self.home, f"node{idx}"), "start"],
@@ -674,6 +683,12 @@ class E2ERunner:
             # result hash must agree with a plain local bisection.  No
             # process disruption here either.
             self._light_gateways[name] = self._light_gateway_swarm(node)
+        elif kind == "bundle_cold_sync":
+            # Export a checkpoint bundle from the live node, KILL the node,
+            # cold-sync a swarm from the static flat-dir artifact with zero
+            # origin interactivity, then relaunch — the heal check proves
+            # the origin was never needed during the syncs.
+            self._bundle_syncs[name] = self._bundle_cold_sync(node, idx)
         elif kind == "disconnect":
             pid = proc.pid
             t_end = time.time() + 4.0
@@ -1039,6 +1054,137 @@ class E2ERunner:
                 f"{name}: cold-sync swarm never took the proof path: {out}"
             )
         return out
+
+    def _bundle_cold_sync(
+        self, node: ManifestNode, idx: int, n_clients: int = 4
+    ) -> dict:
+        """Static cold sync off a checkpoint bundle: fetch the latest
+        bundle over light_bundle while the node is live (plus the trust
+        anchor and expected checkpoint hash), write it to a flat directory
+        the way `cmd bundle export` would, SIGKILL the node, and have N
+        clients sync to the checkpoint from the directory alone — the
+        origin is down for the entire swarm, so any interactivity beyond
+        the bundle fails loudly.  Every client must take the bundle path
+        (no rejects, no fallbacks) and land on the hash the node itself
+        reported before it went down.  The node is relaunched afterwards;
+        the run's heal check proves it rejoins."""
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.light.bundle import (
+            Bundle, DirBundleSource, check_name,
+        )
+        from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.provider import HTTPProvider, MockProvider
+        from cometbft_tpu.light.store import LightStore
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types import cmttime
+
+        name = node.name
+        url = f"http://127.0.0.1:{self.rpc_ports[name]}"
+        rpc = HTTPClient(url, timeout=5)
+        # Let the chain cross a few CMTPU_BUNDLE_INTERVAL=2 boundaries.
+        self.wait_height(name, 4, timeout=420)
+        res = rpc.call("light_bundle")
+        if not res.get("enabled"):
+            raise AssertionError(
+                f"{name}: bundle_cold_sync armed but origin disabled: {res}"
+            )
+        bname = res["name"]
+        data = base64.b64decode(res["bundle"])
+        check_name(bname, data)
+        boundary = int(res["height"])
+        bundle = Bundle.decode(data)
+        # Origin counters ride inside light_gateway_stats (peeked — the
+        # light_bundle call above already constructed the origin).
+        try:
+            origin_stats = rpc.call("light_gateway_stats").get("bundle")
+        except Exception:
+            origin_stats = None
+        # Everything a client will need once the node is dead: the trust
+        # anchor light block and the node's own claim for the checkpoint.
+        live = HTTPProvider("e2e-manifest", rpc)
+        lb1 = live.light_block(1)
+        trust = TrustOptions(
+            period_ns=int(3600 * 10**9), height=1, hash=lb1.hash(),
+        )
+        expected = rpc.block(boundary)["block_id"]["hash"].upper()
+        # The flat-dir artifact exactly as `cmd bundle export` lays it out.
+        out_dir = os.path.join(self.home, f"{name}_bundles")
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, f"{bname}.bundle"), "wb") as f:
+            f.write(data)
+        with open(os.path.join(out_dir, "index.json"), "w") as f:
+            json.dump({
+                "chain_id": "e2e-manifest",
+                "interval": 2,
+                "latest": bname,
+                "bundles": {str(boundary): bname},
+            }, f)
+        # Origin goes DOWN.
+        proc = self.procs[name]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+        results: list = [None] * n_clients
+        barrier = threading.Barrier(n_clients)
+
+        def cold_sync(i: int) -> None:
+            try:
+                barrier.wait(timeout=30)
+                client = Client(
+                    "e2e-manifest", trust,
+                    MockProvider(
+                        "e2e-manifest", {1: lb1, boundary: bundle.anchor}
+                    ),
+                    [], LightStore(MemDB()),
+                    bundle_source=DirBundleSource(out_dir),
+                )
+                lb = client.verify_light_block_at_height(
+                    boundary, cmttime.now()
+                )
+                results[i] = ("ok", lb.hash().hex().upper(),
+                              dict(client.gateway_stats))
+            except Exception as exc:
+                results[i] = ("error", repr(exc))
+
+        threads = [
+            threading.Thread(target=cold_sync, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        try:
+            bad = [r for r in results if r is None or r[0] != "ok"]
+            if bad:
+                raise AssertionError(
+                    f"{name}: bundle cold-sync failures: {bad}"
+                )
+            hashes = {r[1] for r in results}
+            if hashes != {expected}:
+                raise AssertionError(
+                    f"{name}: bundle cold-sync hash disagreement at "
+                    f"{boundary}: {hashes} vs node's {expected}"
+                )
+            syncs = sum(r[2]["bundle_syncs"] for r in results)
+            rejects = sum(r[2]["bundle_rejects"] for r in results)
+            if syncs != n_clients or rejects:
+                raise AssertionError(
+                    f"{name}: swarm did not take the bundle path cleanly: "
+                    f"syncs={syncs} rejects={rejects}"
+                )
+        finally:
+            time.sleep(1.0)
+            self.procs[name] = self._launch(idx)
+        return {
+            "clients": n_clients,
+            "height": boundary,
+            "name": bname,
+            "bundle_bytes": len(data),
+            "hash": expected,
+            "bundle_syncs": syncs,
+            "origin": origin_stats,
+        }
 
     def _vote_batch_check(self, name: str, after_height: int) -> dict:
         """Zero-valid-vote-loss probe for the vote_batch perturbation: scan
@@ -1419,6 +1565,8 @@ class E2ERunner:
                 report["mixed_load"] = self._mixed_loads
             if self._recv_floods:
                 report["recv_flood"] = self._recv_floods
+            if self._bundle_syncs:
+                report["bundle_cold_sync"] = self._bundle_syncs
             if churn_report is not None:
                 report["validator_churn"] = churn_report
             if light_report is not None:

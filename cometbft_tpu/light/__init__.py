@@ -6,8 +6,19 @@
 - provider: Provider interface (mock / http)
 - store:    DB-backed trusted LightBlock store
 - proxy:    verified RPC proxy (`cometbft light` daemon)
+- mmr:      append-only RFC-6962 accumulator over committed headers
+- gateway:  node-side shared-verification sync service (interactive)
+- bundle:   content-addressed checkpoint artifacts (static cold sync)
+- origin:   node-side bundle builder/exporter — the CDN origin
 """
 
+from cometbft_tpu.light.bundle import (
+    Bundle,
+    BundleError,
+    DirBundleSource,
+    MemoryBundleSource,
+    RemoteBundleSource,
+)
 from cometbft_tpu.light.client import Client, TrustOptions
 from cometbft_tpu.light.gateway import (
     GatewayError,
@@ -15,6 +26,7 @@ from cometbft_tpu.light.gateway import (
     RemoteGateway,
 )
 from cometbft_tpu.light.mmr import MMR
+from cometbft_tpu.light.origin import BundleOrigin
 from cometbft_tpu.light.provider import (
     BlockStoreProvider,
     ErrLightBlockNotFound,
@@ -37,6 +49,12 @@ __all__ = [
     "LightGateway",
     "RemoteGateway",
     "GatewayError",
+    "Bundle",
+    "BundleError",
+    "BundleOrigin",
+    "DirBundleSource",
+    "MemoryBundleSource",
+    "RemoteBundleSource",
     "MMR",
     "verifier",
     "ErrLightBlockNotFound",

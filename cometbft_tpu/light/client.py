@@ -69,6 +69,7 @@ class Client:
         skip_verification: str = "skipping",  # or "sequential"
         gateway=None,  # LightGateway / RemoteGateway: untrusted accelerator
         gateway_proofs: bool | None = None,  # try the MMR proof path first
+        bundle_source=None,  # checkpoint-bundle source (light/bundle.py)
         logger=None,
     ):
         verifier.validate_trust_level(trust_level)
@@ -89,6 +90,10 @@ class Client:
 
             gateway_proofs = proof_mode() == "mmr"
         self.gateway_proofs = gateway_proofs
+        self.bundle_source = bundle_source
+        # p2p re-serving: the raw bytes of the last bundle THIS client
+        # verified — handed onward unchanged via self.bundle().
+        self._held_bundle: bytes | None = None
         self.logger = logger
         # Speculative-bisection counters (bench/e2e observability).
         self.speculation = {"descents": 0, "prewarmed_sigs": 0}
@@ -100,6 +105,9 @@ class Client:
             "proof_rejects": 0,
             "fallbacks": 0,
             "proof_bytes": 0,
+            "bundle_syncs": 0,
+            "bundle_rejects": 0,
+            "bundle_bytes": 0,
         }
         self._init_trust(trust_options)
 
@@ -172,10 +180,20 @@ class Client:
         if new_lb.height > trusted.height:
             if self.mode == "sequential":
                 trace = self._verify_sequential(trusted, new_lb, now)
-            elif self.gateway is not None:
-                trace = self._verify_with_gateway(trusted, new_lb, now)
             else:
-                trace = self._verify_skipping(trusted, new_lb, now)
+                # Cold-sync ladder: checkpoint bundle (zero interactivity,
+                # tried before any CMTPU_LIGHTGW_PROOF mode) -> gateway
+                # proof/plan -> local bisection.  Every rung re-derives
+                # the same trust check, so a refusal only costs the next
+                # rung, never the decision.
+                trace = None
+                if self.bundle_source is not None:
+                    trace = self._try_verify_bundle(trusted, new_lb, now)
+                if trace is None:
+                    if self.gateway is not None:
+                        trace = self._verify_with_gateway(trusted, new_lb, now)
+                    else:
+                        trace = self._verify_skipping(trusted, new_lb, now)
             for lb in trace:
                 self.store.save_light_block(lb)
         elif new_lb.height < self.store.first_light_block_height():
@@ -301,6 +319,81 @@ class Client:
                     bv.verify()  # cache-filters, dedups, populates _verified
         except Exception:
             pass
+
+    # -- checkpoint-bundle cold sync (light/bundle.py; static artifact) -------
+
+    def _try_verify_bundle(self, trusted: LightBlock, target: LightBlock,
+                           now: Time):
+        """Zero-interactivity cold sync off a checkpoint bundle; returns a
+        trace or None (refusal -> the caller falls through to the gateway
+        or bisection — a forged/stale bundle can never cause a wrong
+        accept, only this fallback).
+
+        Acceptance is Bundle.verify: our OWN trust anchor must be a
+        ladder rung with our OWN stored hash, every rung must prove into
+        the root the shipped peaks bag to, and the anchor light block
+        must pass the standard trusting-overlap + commit check — the
+        exact interactive-path predicate, so decisions stay
+        bit-identical.  When the checkpoint sits below the target the
+        verified anchor becomes the new trusted base and the remaining
+        span rides the normal paths."""
+        from cometbft_tpu.light.bundle import Bundle
+
+        try:
+            raw = self.bundle_source.bundle(target.height)
+            if raw is None:
+                raise ValueError("no bundle available")
+            bundle = raw if isinstance(raw, Bundle) else Bundle.decode(raw)
+            data = bundle.encode() if isinstance(raw, Bundle) else raw
+            if bundle.anchor.height > target.height:
+                raise ValueError(
+                    f"bundle checkpoint {bundle.anchor.height} above "
+                    f"target {target.height}"
+                )
+            anchor = bundle.verify(
+                self.chain_id, trusted, now, self.trusting_period_ns,
+                self.max_clock_drift_ns, self.trust_level,
+            )
+            if anchor.height == target.height and \
+                    anchor.hash() != target.hash():
+                # The artifact verified but names a different header than
+                # our primary at the same height — a conflict the bundle
+                # path must not arbitrate.  Refuse; the interactive walk
+                # (and the detector) handles it against the primary.
+                raise ValueError("bundle anchor disagrees with primary")
+        except Exception as e:
+            self.gateway_stats["bundle_rejects"] += 1
+            if self.logger:
+                self.logger.info(
+                    "checkpoint bundle rejected; falling back",
+                    module="light", err=repr(e),
+                )
+            return None
+        self.gateway_stats["bundle_syncs"] += 1
+        self.gateway_stats["bundle_bytes"] += len(data)
+        self._held_bundle = data
+        if anchor.height == target.height:
+            # Keep OUR target object as the decision object (hash-equal).
+            return [target]
+        trace = [anchor]
+        if self.gateway is not None:
+            trace.extend(self._verify_with_gateway(anchor, target, now))
+        else:
+            trace.extend(self._verify_skipping(anchor, target, now))
+        return trace
+
+    def bundle(self, height: int = 0) -> bytes | None:
+        """BundleSource duck type: peer-to-peer re-serving.  A synced
+        client hands the exact bytes it verified onward — the next client
+        re-derives everything, so relaying costs no trust."""
+        if self._held_bundle is None:
+            return None
+        if height:
+            from cometbft_tpu.light.bundle import Bundle
+
+            if Bundle.decode(self._held_bundle).anchor.height > height:
+                return None
+        return self._held_bundle
 
     # -- gateway-assisted sync (light/gateway.py; untrusted accelerator) ------
 

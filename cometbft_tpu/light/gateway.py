@@ -55,7 +55,7 @@ import threading
 
 from cometbft_tpu.light import verifier
 from cometbft_tpu.sidecar import engine
-from cometbft_tpu.light.mmr import MMR
+from cometbft_tpu.light import mmr as mmr_mod
 from cometbft_tpu.light.provider import Provider
 from cometbft_tpu.types.light_block import LightBlock
 from cometbft_tpu.types.validation import Fraction
@@ -96,11 +96,13 @@ class LightGateway:
         max_sessions: int | None = None,
         plan_cache: int | None = None,
         trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
+        state_path: str | None = None,
         logger=None,
     ):
         self.chain_id = chain_id
         self.source = source
         self.trust_level = trust_level
+        self.state_path = state_path
         self.logger = logger
         self.max_sessions = max_sessions if max_sessions is not None else max(
             1, _env_int("CMTPU_LIGHTGW_SESSIONS", 64)
@@ -108,7 +110,9 @@ class LightGateway:
         self.plan_cache_max = plan_cache if plan_cache is not None else max(
             1, _env_int("CMTPU_LIGHTGW_PLAN_CACHE", 256)
         )
-        self._mmr = MMR()
+        # Lazy: resumed from the persisted state file (if any) on first
+        # proof — see _ensure_mmr.
+        self._mmr: mmr_mod.MMR | None = None
         self._mmr_lock = threading.Lock()
         # (trusted_height, target_height) -> tuple of plan heights (sorted,
         # target included). Insertion-ordered dict as LRU, refresh-on-reput.
@@ -344,18 +348,26 @@ class LightGateway:
                 return h
         return self._fetch(height).hash()
 
+    def _safe_header_hash(self, height: int) -> bytes | None:
+        try:
+            return self._header_hash(height)
+        except Exception:
+            return None
+
     def _ensure_mmr(self) -> None:
-        """Append committed header hashes up to the source's tip. Header
-        hashes are immutable once committed, so append-only is safe.
+        """Resume the accumulator from the persisted state file (if any)
+        and append committed header hashes up to the source's tip. Header
+        hashes are immutable once committed, so append-only is safe; a
+        state file that disagrees with its own peaks or with the block
+        store refuses loudly (mmr.resume_or_new), it is never papered
+        over with a silent rebuild.
 
         Leaf index = height - 1, so proof serving needs the full history
         from height 1: a pruned store (base > 1) is refused loudly up
         front instead of letting every cold client pay a doomed per-block
-        fetch.  Catch-up fetches run in bounded chunks OUTSIDE the lock —
-        a tall-chain first prove() must not stall concurrent proof
-        sessions or the stats()/mmr_size readers — and each append
-        re-checks the size under the lock, so concurrent catch-ups
-        (hashes are deterministic per height) never double-append."""
+        fetch.  Catch-up (mmr.catch_up, shared with the bundle origin)
+        fetches in bounded chunks outside the lock so a tall-chain first
+        prove() never stalls concurrent proof sessions."""
         base_fn = getattr(self.source, "base_height", None)
         if base_fn is not None:
             base = int(base_fn() or 1)
@@ -368,17 +380,21 @@ class LightGateway:
             latest = self.source.light_block(0).height
         except Exception as e:
             raise GatewayError(f"source tip unavailable: {e}") from e
-        while True:
+        with self._mmr_lock:
+            if self._mmr is None:
+                try:
+                    self._mmr = mmr_mod.resume_or_new(
+                        self.state_path, self._safe_header_hash
+                    )
+                except mmr_mod.MMRStateError as e:
+                    raise GatewayError(str(e)) from e
+        grew = mmr_mod.catch_up(
+            self._mmr, self._mmr_lock, latest, self._header_hash,
+            chunk=_MMR_CATCHUP_CHUNK,
+        )
+        if grew and self.state_path:
             with self._mmr_lock:
-                next_h = self._mmr.size + 1
-            if next_h > latest:
-                return
-            hi = min(latest, next_h + _MMR_CATCHUP_CHUNK - 1)
-            hashes = [(h, self._header_hash(h)) for h in range(next_h, hi + 1)]
-            with self._mmr_lock:
-                for h, digest in hashes:
-                    if h == self._mmr.size + 1:
-                        self._mmr.append(digest)
+                mmr_mod.save_state(self._mmr, self.state_path)
 
     def prove(self, height: int, anchor_height: int = 0) -> dict:
         """Target light block + inclusion proofs for the target header and
@@ -434,7 +450,10 @@ class LightGateway:
         with self._plan_lock:
             out["plans_cached"] = len(self._plans)
         with self._mmr_lock:
-            out["mmr_size"] = self._mmr.size
+            out["mmr_size"] = self._mmr.size if self._mmr is not None else 0
+        # Stable external name for the proof wire-bytes counter (the
+        # internal key predates it and keeps feeding existing readers).
+        out["proof_bytes_served"] = out["proof_bytes"]
         shared = out["plan_hits"] + out["plan_waits"]
         out["plan_share_ratio"] = round(
             (shared + out["plan_misses"]) / max(1, out["plan_misses"]), 3

@@ -337,6 +337,18 @@ class Node:
         self._light_gateway = None
         self._light_gateway_lock = threading.Lock()
 
+        # Checkpoint-bundle origin (light/origin.py): same lazy contract —
+        # built on the first light_bundle RPC / export, never at boot, and
+        # the bundle gauges read _bundle_origin directly.
+        self._bundle_origin = None
+        self._bundle_origin_lock = threading.Lock()
+
+    def _mmr_state_path(self) -> str:
+        """One persisted accumulator state file under the node's db dir,
+        shared by the gateway and the bundle origin (identical content at
+        any size; writes are atomic replaces)."""
+        return os.path.join(self.config.base.db_path(), "light_mmr.state")
+
     def light_gateway(self):
         """The node's LightGateway over its local stores; None when
         CMTPU_LIGHTGW=0 disables serving."""
@@ -356,9 +368,38 @@ class Node:
                         self.block_store,
                         self.state_store,
                     ),
+                    state_path=self._mmr_state_path(),
                     logger=self.logger,
                 )
             return self._light_gateway
+
+    def bundle_origin(self, build: bool = True):
+        """The node's BundleOrigin over its local stores; None when
+        CMTPU_BUNDLE=0 disables the subsystem.  build=False peeks at the
+        already-constructed origin (stats/metrics paths) without ever
+        constructing one."""
+        from cometbft_tpu.light.origin import bundles_enabled
+
+        if not bundles_enabled():
+            return None
+        if not build:
+            return self._bundle_origin
+        with self._bundle_origin_lock:
+            if self._bundle_origin is None:
+                from cometbft_tpu.light.origin import BundleOrigin
+                from cometbft_tpu.light.provider import BlockStoreProvider
+
+                self._bundle_origin = BundleOrigin(
+                    self.genesis_doc.chain_id,
+                    BlockStoreProvider(
+                        self.genesis_doc.chain_id,
+                        self.block_store,
+                        self.state_store,
+                    ),
+                    state_path=self._mmr_state_path(),
+                    logger=self.logger,
+                )
+            return self._bundle_origin
 
     @staticmethod
     def _register_backend_metrics(reg) -> None:
@@ -838,6 +879,33 @@ class Node:
         reg.gauge_func("lightgw", "plan_share_ratio_milli",
                        "Plans served per plan computed x1000.",
                        gw_share_milli)
+        reg.gauge_func("lightgw", "proof_bytes_served",
+                       "Total wire bytes of MMR cold-sync proofs served.",
+                       gw("proof_bytes_served"))
+
+        # Bundle-origin gauges: same passive contract against
+        # _bundle_origin — a scrape never constructs the origin.
+        def bo(key):
+            def fn():
+                o = getattr(self, "_bundle_origin", None)
+                if o is None:
+                    return 0
+                return int(o.stats().get(key, 0))
+            return fn
+
+        reg.gauge_func("lightgw", "bundles_built",
+                       "Checkpoint bundles frozen by the origin.",
+                       bo("bundles_built"))
+        reg.gauge_func("lightgw", "bundle_hits",
+                       "Checkpoint bundle serves (RPC/export/in-process).",
+                       bo("bundle_hits"))
+        reg.gauge_func("lightgw", "bundle_fallbacks",
+                       "Bundle requests refused (no checkpoint/pruned/"
+                       "mismatch) — the client fell back interactively.",
+                       bo("bundle_fallbacks"))
+        reg.gauge_func("lightgw", "bundle_bytes_served",
+                       "Total wire bytes of checkpoint bundles served.",
+                       bo("bundle_bytes_served"))
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -918,6 +986,7 @@ class Node:
                 proxy_app_query=self.proxy_app.query,
                 p2p_peers=self.switch,
                 light_gateway=self.light_gateway,
+                bundle_origin=self.bundle_origin,
             )
             self._rpc_env = env
             routes_map = routes(env)

@@ -44,6 +44,10 @@ class Environment:
     # node's LightGateway (constructing it on first use) or None when
     # disabled — lazy so serving unrelated RPC never builds the gateway.
     light_gateway: object = None
+    # Checkpoint-bundle origin accessor: callable(build=True) returning
+    # the node's BundleOrigin (build=False peeks without constructing) or
+    # None when CMTPU_BUNDLE=0.
+    bundle_origin: object = None
     is_listening: bool = True
 
 
@@ -616,14 +620,44 @@ def routes(env: Environment) -> dict:
             }
         return out
 
+    def light_bundle(height="0"):
+        """Latest checkpoint bundle at or below `height` (0 = newest),
+        content-addressed: `name` is the hex SHA-256 of the returned
+        bytes, so any cache between this origin and the client is
+        verifiable end-to-end."""
+        from cometbft_tpu.light.bundle import BundleError
+
+        accessor = env.bundle_origin
+        o = accessor() if callable(accessor) else accessor
+        if o is None:
+            return {"enabled": False}
+        try:
+            name, data, boundary = o.get_encoded(int(height))
+        except BundleError as e:
+            raise RPCError(-32603, f"light_bundle: {e}", None)
+        return {
+            "enabled": True,
+            "name": name,
+            "height": str(boundary),
+            "bundle": _b64(data),
+        }
+
     def light_gateway_stats():
         """Gateway counters (sessions, plan cache, proofs) for operators
-        and the e2e swarm perturbations' delta checks."""
+        and the e2e swarm perturbations' delta checks.  Bundle-origin
+        counters ride along when the origin already exists — peeked, not
+        built: a stats scrape never constructs the origin."""
         accessor = env.light_gateway
         g = accessor() if callable(accessor) else accessor
         if g is None:
-            return {"enabled": False}
-        return {"enabled": True, **g.stats()}
+            out = {"enabled": False}
+        else:
+            out = {"enabled": True, **g.stats()}
+        peek = env.bundle_origin
+        o = peek(build=False) if callable(peek) else None
+        if o is not None:
+            out["bundle"] = o.stats()
+        return out
 
     def tx(hash="", prove=False):
         if env.tx_indexer is None:
@@ -803,6 +837,7 @@ def routes(env: Environment) -> dict:
         "recvq_stats": recvq_stats,
         "light_sync": light_sync,
         "light_proof": light_proof,
+        "light_bundle": light_bundle,
         "light_gateway_stats": light_gateway_stats,
         "abci_info": abci_info,
         "abci_query": abci_query,

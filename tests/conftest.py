@@ -124,3 +124,11 @@ def pytest_configure(config):
         "unknown-channel peer teardown, recv flow accounting); runs in "
         "tier-1 — `-m recvq` selects just this group",
     )
+    config.addinivalue_line(
+        "markers",
+        "bundle: checkpoint-bundle tests (wire round-trip + content "
+        "addressing, tamper-matrix refusal with fallback, client cold "
+        "sync off origin/dir/peer sources, persisted-MMR restart-resume, "
+        "same-chain export determinism); runs in tier-1 — `-m bundle` "
+        "selects just this group",
+    )

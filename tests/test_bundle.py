@@ -1,0 +1,492 @@
+"""Checkpoint-bundle tests (light/bundle.py + light/origin.py + the MMR
+persistence they share with the gateway).
+
+The invariant under test everywhere: a bundle is history-binding, never
+trust.  Any tamper — flipped commit bit, wrong valset, truncated ladder,
+corrupted content address, forged history, stale checkpoint — must be
+REFUSED client-side and cost exactly one fallback to the interactive
+paths, whose decision is then bit-identical to plain bisection.  Zero
+wrong accepts."""
+
+import os
+
+import pytest
+
+from test_light import (
+    CHAIN_ID,
+    HOUR_NS,
+    T0,
+    ChainMaker,
+    CountingProvider,
+    _client,
+)
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.light.bundle import (
+    Bundle,
+    BundleError,
+    DirBundleSource,
+    MemoryBundleSource,
+    RemoteBundleSource,
+    check_name,
+    ladder_heights,
+)
+from cometbft_tpu.light.gateway import GatewayError, LightGateway
+from cometbft_tpu.light.mmr import (
+    MMR,
+    MMRStateError,
+    load_state,
+    resume_or_new,
+    save_state,
+)
+from cometbft_tpu.light.origin import BundleOrigin
+from cometbft_tpu.types.cmttime import Time
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.priv_validator import MockPV
+
+pytestmark = pytest.mark.bundle
+
+NOW = Time(T0 + 1000, 0)
+
+# Pinned content address of the deterministic golden chain's checkpoint
+# at height 8 (secret-seeded keys, fixed header times): the wire format
+# is frozen — an encode change MUST change this test, deliberately.
+GOLDEN_NAME = "fdbaac380d82d696612828bc5bf3de9621949c4764226390c523fbacc8f612db"
+
+
+def _origin(chain, interval=8, **kw):
+    return BundleOrigin(CHAIN_ID, chain.provider(), interval=interval, **kw)
+
+
+def _golden_chain():
+    pool = [
+        MockPV(ed25519.gen_priv_key_from_secret(f"bundle-golden-{i}".encode()))
+        for i in range(3)
+    ]
+    return ChainMaker(n_vals=3, heights=8, rotate=0, pool=pool)
+
+
+# -- wire format / content addressing ---------------------------------------
+
+
+def test_golden_vector_roundtrip_and_name():
+    chain = _golden_chain()
+    name, data, boundary = _origin(chain).get_encoded(0)
+    assert boundary == 8
+    assert name == GOLDEN_NAME
+    check_name(name, data)  # sha256 really is the name
+    b = Bundle.decode(data)
+    assert b.encode() == data, "decode -> re-encode must be byte-identical"
+    assert b.name == name
+    # And a second decode of the re-encode stays stable.
+    assert Bundle.decode(b.encode()).encode() == data
+    b.self_check(CHAIN_ID)
+
+
+def test_ladder_geometry():
+    assert ladder_heights(1) == [1]
+    assert ladder_heights(8) == [8, 4, 2, 1]
+    assert ladder_heights(24) == [24, 12, 6, 3, 1]
+    chain = ChainMaker(n_vals=3, heights=24)
+    b = _origin(chain).get(0)
+    assert [hop.height for hop in b.ladder] == [24, 12, 6, 3, 1]
+    assert b.ladder[0].header_hash == b.anchor.hash()
+    assert b.mmr_size == b.anchor.height == 24
+    for hop in b.ladder:
+        assert hop.header_hash == chain.blocks[hop.height].hash()
+
+
+# -- origin: checkpoints, bounded store, counters ---------------------------
+
+
+def test_origin_checkpoints_and_bounded_store():
+    chain = ChainMaker(n_vals=3, heights=40)
+    origin = _origin(chain, interval=8, keep=3)
+    assert origin.get(0).anchor.height == 40
+    assert origin.get(17).anchor.height == 16
+    assert origin.get(8).anchor.height == 8
+    # keep=3 bounds the encoded store (lowest evicted)...
+    st = origin.stats()
+    assert st["bundles_stored"] <= 3
+    # ...but an evicted checkpoint is rebuilt on demand, bit-identically.
+    name1, data1, _ = origin.get_encoded(8)
+    origin.get(0), origin.get(24), origin.get(32)
+    name2, data2, _ = origin.get_encoded(8)
+    assert (name1, data1) == (name2, data2)
+    assert st["bundles_built"] >= 3 and st["bundle_hits"] >= 3
+
+
+def test_no_checkpoint_yet_is_a_loud_fallback():
+    chain = ChainMaker(n_vals=3, heights=10)
+    origin = _origin(chain, interval=64)
+    with pytest.raises(BundleError):
+        origin.get_encoded(0)
+    assert origin.stats()["bundle_fallbacks"] == 1
+    assert origin.bundle(0) is None  # source duck type: None, not raise
+
+
+# -- client cold sync -------------------------------------------------------
+
+
+def test_cold_sync_offline_zero_interactivity():
+    """With the trust anchor pre-stored and a bundle in hand, sync needs
+    the primary only for the target object itself — no pivots, no proofs,
+    no gateway."""
+    chain = ChainMaker(n_vals=3, heights=24)
+    data = _origin(chain).bundle(0)
+    # Primary knows ONLY the trust anchor and the target: any other fetch
+    # would raise ErrLightBlockNotFound and fail the test.
+    sparse = CountingProvider(
+        CHAIN_ID, {1: chain.blocks[1], 24: chain.blocks[24]}
+    )
+    c = _client(chain, provider=sparse)
+    c.bundle_source = MemoryBundleSource(data)
+    got = c.verify_light_block_at_height(24, NOW)
+    assert got.hash() == chain.blocks[24].hash()
+    assert c.gateway_stats["bundle_syncs"] == 1
+    assert c.gateway_stats["bundle_rejects"] == 0
+    assert sparse.fetches == 2  # _init_trust(1) + target(24), nothing else
+
+
+def test_cold_sync_decision_bit_identical_to_bisection():
+    chain = ChainMaker(n_vals=3, heights=24)
+    data = _origin(chain).bundle(0)
+    via_bundle = _client(chain)
+    via_bundle.bundle_source = MemoryBundleSource(data)
+    assert via_bundle.verify_light_block_at_height(24, NOW)
+    reference = _client(chain)
+    assert reference.verify_light_block_at_height(24, NOW)
+    assert sorted(via_bundle.store._heights()) == \
+        sorted(reference.store._heights())
+    for h in reference.store._heights():
+        assert via_bundle.store.light_block(h).hash() == \
+            reference.store.light_block(h).hash()
+
+
+def test_rotation_diluted_overlap_refuses_and_falls_back():
+    """Heavy rotation kills the 1/3 overlap between the client's anchor
+    set and the checkpoint's set — the bundle path must refuse (the same
+    trusting-overlap predicate interactive sync applies) and bisection
+    must still land the identical decision."""
+    chain = ChainMaker(n_vals=4, heights=16, rotate=1)
+    data = _origin(chain, interval=16).bundle(0)
+    c = _client(chain)
+    c.bundle_source = MemoryBundleSource(data)
+    got = c.verify_light_block_at_height(16, NOW)
+    assert got.hash() == chain.blocks[16].hash()
+    assert c.gateway_stats["bundle_syncs"] == 0
+    assert c.gateway_stats["bundle_rejects"] == 1
+    reference = _client(chain)
+    reference.verify_light_block_at_height(16, NOW)
+    assert sorted(c.store._heights()) == sorted(reference.store._heights())
+
+
+def test_checkpoint_below_target_continues_interactively():
+    chain = ChainMaker(n_vals=3, heights=21)
+    origin = _origin(chain, interval=8)
+    c = _client(chain)
+    c.bundle_source = origin  # origin itself is a BundleSource
+    got = c.verify_light_block_at_height(21, NOW)
+    assert got.hash() == chain.blocks[21].hash()
+    assert c.gateway_stats["bundle_syncs"] == 1
+    # The checkpoint anchor entered the trusted store on the way.
+    assert 16 in c.store._heights() and 21 in c.store._heights()
+
+
+def test_p2p_reserve_client_hands_bundle_onward():
+    chain = ChainMaker(n_vals=3, heights=24)
+    data = _origin(chain).bundle(0)
+    first = _client(chain)
+    first.bundle_source = MemoryBundleSource(data)
+    first.verify_light_block_at_height(24, NOW)
+    assert first.bundle(0) == data  # exact verified bytes re-served
+    second = _client(chain)
+    second.bundle_source = first  # a synced client IS a source
+    second.verify_light_block_at_height(24, NOW)
+    assert second.gateway_stats["bundle_syncs"] == 1
+
+
+# -- tamper matrix: refusal + fallback, never wrong-accept ------------------
+
+
+def _flip_commit_bit(chain, data):
+    """Flip one bit inside a commit signature via wire surgery — the
+    bundle still decodes, still self-checks structurally, and must die on
+    the client's own +2/3 commit verification."""
+    b = Bundle.decode(data)
+    sig = b.anchor.signed_header.commit.signatures[0].signature
+    pos = data.find(sig)
+    assert pos > 0
+    out = bytearray(data)
+    out[pos] ^= 1
+    return bytes(out)
+
+
+def _wrong_anchor_valset(chain, data):
+    b = Bundle.decode(data)
+    # A different committee's set: validators_hash in the (signed) header
+    # can no longer match, so validate_basic must refuse.
+    other = ChainMaker(n_vals=4, heights=1).blocks[1].validator_set
+    forged = Bundle(
+        chain_id=b.chain_id,
+        anchor=LightBlock(b.anchor.signed_header, other),
+        mmr_size=b.mmr_size,
+        peaks=b.peaks,
+        ladder=b.ladder,
+    )
+    return forged.encode()
+
+
+def _truncated_ladder(chain, data):
+    b = Bundle.decode(data)
+    return Bundle(
+        chain_id=b.chain_id,
+        anchor=b.anchor,
+        mmr_size=b.mmr_size,
+        peaks=b.peaks,
+        ladder=b.ladder[:-1],
+    ).encode()
+
+
+def _forged_history(chain, data):
+    """A fully self-consistent bundle from a DIFFERENT committee (same
+    chain id) — internally perfect, but its history cannot contain the
+    client's trust anchor."""
+    other = ChainMaker(n_vals=3, heights=24)
+    return BundleOrigin(CHAIN_ID, other.provider(), interval=8).bundle(0)
+
+
+def _stale_checkpoint(chain, data):
+    """Checkpoint at the client's own trusted height — nothing to gain,
+    must refuse rather than re-accept."""
+    return BundleOrigin(CHAIN_ID, chain.provider(), interval=1).bundle(1)
+
+
+def _garbage(chain, data):
+    return b"\xde\xad" * 40
+
+
+@pytest.mark.parametrize(
+    "tamper",
+    [
+        _flip_commit_bit,
+        _wrong_anchor_valset,
+        _truncated_ladder,
+        _forged_history,
+        _stale_checkpoint,
+        _garbage,
+    ],
+    ids=[
+        "flipped-commit-bit",
+        "wrong-anchor-valset",
+        "truncated-ladder",
+        "forged-history",
+        "stale-checkpoint",
+        "garbage-bytes",
+    ],
+)
+def test_tamper_matrix_refuses_then_falls_back(tamper):
+    chain = ChainMaker(n_vals=3, heights=24)
+    data = _origin(chain).bundle(0)
+    poisoned = tamper(chain, data)
+    assert poisoned != data
+    c = _client(chain)
+    c.bundle_source = MemoryBundleSource(poisoned)
+    got = c.verify_light_block_at_height(24, NOW)
+    # The sync completed via fallback with the honest decision...
+    assert got.hash() == chain.blocks[24].hash()
+    reference = _client(chain)
+    reference.verify_light_block_at_height(24, NOW)
+    assert sorted(c.store._heights()) == sorted(reference.store._heights())
+    # ...and the poisoned artifact was never accepted.
+    assert c.gateway_stats["bundle_syncs"] == 0
+    assert c.gateway_stats["bundle_rejects"] == 1
+    assert c.bundle(0) is None  # nothing unverified is ever re-served
+
+
+def test_mismatched_content_address_dies_at_the_source(tmp_path):
+    chain = ChainMaker(n_vals=3, heights=24)
+    origin = _origin(chain)
+    index = origin.export(str(tmp_path))
+    name = index["latest"]
+    blob = tmp_path / f"{name}.bundle"
+    raw = bytearray(blob.read_bytes())
+    raw[len(raw) // 2] ^= 1
+    blob.write_bytes(bytes(raw))
+    src = DirBundleSource(str(tmp_path))
+    with pytest.raises(BundleError, match="content address"):
+        src.bundle(0)
+    # And a client over that source just falls back.
+    c = _client(chain)
+    c.bundle_source = src
+    got = c.verify_light_block_at_height(24, NOW)
+    assert got.hash() == chain.blocks[24].hash()
+    assert c.gateway_stats["bundle_rejects"] == 1
+
+
+# -- flat-directory export / determinism ------------------------------------
+
+
+def test_export_determinism_and_dir_cold_sync(tmp_path):
+    chain = ChainMaker(n_vals=3, heights=32)
+    idx1 = _origin(chain).export(str(tmp_path / "a"))
+    idx2 = _origin(chain).export(str(tmp_path / "b"))
+    assert idx1 == idx2, "same chain must export identical indexes"
+    for h, name in idx1["bundles"].items():
+        b1 = (tmp_path / "a" / f"{name}.bundle").read_bytes()
+        b2 = (tmp_path / "b" / f"{name}.bundle").read_bytes()
+        assert b1 == b2, f"bundle at {h} not byte-identical across exports"
+    src = DirBundleSource(str(tmp_path / "a"))
+    c = _client(chain)
+    c.bundle_source = src
+    assert c.verify_light_block_at_height(32, NOW).hash() == \
+        chain.blocks[32].hash()
+    assert c.gateway_stats["bundle_syncs"] == 1
+
+
+def test_remote_bundle_source_checks_name():
+    chain = ChainMaker(n_vals=3, heights=24)
+    origin = _origin(chain)
+    name, data, boundary = origin.get_encoded(0)
+
+    class StubRPC:
+        def __init__(self, res):
+            self.res = res
+
+        def call(self, method, **kw):
+            assert method == "light_bundle"
+            return self.res
+
+    import base64
+
+    good = StubRPC({"enabled": True, "name": name, "height": str(boundary),
+                    "bundle": base64.b64encode(data).decode()})
+    assert RemoteBundleSource(good).bundle(0) == data
+    bad = StubRPC({"enabled": True, "name": name, "height": str(boundary),
+                   "bundle": base64.b64encode(b"x" + data[1:]).decode()})
+    with pytest.raises(BundleError, match="content address"):
+        RemoteBundleSource(bad).bundle(0)
+    off = StubRPC({"enabled": False})
+    assert RemoteBundleSource(off).bundle(0) is None
+
+
+# -- persisted MMR: restart-resume, loud mismatch ---------------------------
+
+
+def test_mmr_restart_resume_skips_rebuild(tmp_path):
+    chain = ChainMaker(n_vals=3, heights=24)
+    state = str(tmp_path / "mmr.state")
+    prov1 = CountingProvider(CHAIN_ID, chain.blocks)
+    o1 = BundleOrigin(CHAIN_ID, prov1, interval=8, state_path=state)
+    name1, _, _ = o1.get_encoded(0)
+    cold_fetches = prov1.fetches
+    assert os.path.exists(state)
+    # Fresh origin, same state file: no per-height history refetch.
+    prov2 = CountingProvider(CHAIN_ID, chain.blocks)
+    o2 = BundleOrigin(CHAIN_ID, prov2, interval=8, state_path=state)
+    name2, _, _ = o2.get_encoded(0)
+    assert name2 == name1
+    # tip probe + last-leaf cross-check + anchor + O(log n) ladder
+    # headers — never the O(heights) history walk the cold build paid.
+    assert prov2.fetches <= 8 < cold_fetches
+
+
+def test_mmr_resume_is_append_only_across_growth(tmp_path):
+    full = ChainMaker(n_vals=3, heights=24)
+    short = {h: lb for h, lb in full.blocks.items() if h <= 16}
+    state = str(tmp_path / "mmr.state")
+    o1 = BundleOrigin(
+        CHAIN_ID, CountingProvider(CHAIN_ID, short), interval=8,
+        state_path=state,
+    )
+    o1.get_encoded(0)
+    prov = CountingProvider(CHAIN_ID, full.blocks)
+    o2 = BundleOrigin(CHAIN_ID, prov, interval=8, state_path=state)
+    assert o2.get(0).anchor.height == 24
+    # Resumed at 16, appended only 17..24.
+    assert prov.fetches < 16
+    # And the state file now reflects the grown accumulator.
+    assert load_state(state).size == 24
+
+
+def test_gateway_and_origin_share_the_state_file(tmp_path):
+    chain = ChainMaker(n_vals=3, heights=24)
+    state = str(tmp_path / "mmr.state")
+    origin = BundleOrigin(CHAIN_ID, chain.provider(), interval=8,
+                          state_path=state)
+    origin.get_encoded(0)
+    prov = CountingProvider(CHAIN_ID, chain.blocks)
+    gw = LightGateway(CHAIN_ID, prov, state_path=state)
+    p = gw.prove(5, anchor_height=1)
+    assert p["size"] == 24
+    assert prov.fetches <= 4  # resumed, not rebuilt
+    assert gw.stats()["proof_bytes_served"] == gw.stats()["proof_bytes"] > 0
+
+
+def test_tampered_state_file_refused_loudly(tmp_path):
+    chain = ChainMaker(n_vals=3, heights=24)
+    state = str(tmp_path / "mmr.state")
+    o1 = BundleOrigin(CHAIN_ID, chain.provider(), interval=8,
+                      state_path=state)
+    o1.get_encoded(0)
+    raw = bytearray(open(state, "rb").read())
+    raw[-1] ^= 1
+    open(state, "wb").write(bytes(raw))
+    o2 = BundleOrigin(CHAIN_ID, chain.provider(), interval=8,
+                      state_path=state)
+    with pytest.raises(BundleError, match="peaks"):
+        o2.get_encoded(0)
+    gw = LightGateway(CHAIN_ID, chain.provider(), state_path=state)
+    with pytest.raises(GatewayError, match="peaks"):
+        gw.prove(5, anchor_height=1)
+
+
+def test_state_file_from_another_chain_refused(tmp_path):
+    a = ChainMaker(n_vals=3, heights=24)
+    b = ChainMaker(n_vals=3, heights=24)  # different keys, different hashes
+    state = str(tmp_path / "mmr.state")
+    BundleOrigin(CHAIN_ID, a.provider(), interval=8,
+                 state_path=state).get_encoded(0)
+    ob = BundleOrigin(CHAIN_ID, b.provider(), interval=8, state_path=state)
+    with pytest.raises(BundleError, match="does not match the source"):
+        ob.get_encoded(0)
+
+
+def test_mmr_historical_proofs_match_frozen_tree():
+    """prove_at/root_at against the live accumulator == what a tree frozen
+    at that size produces — the property that lets ONE accumulator serve
+    every checkpoint."""
+    leaves = [bytes([i]) * 32 for i in range(25)]
+    live = MMR()
+    for d in leaves:
+        live.append(d)
+    for size in (1, 2, 7, 16, 24, 25):
+        frozen = MMR()
+        for d in leaves[:size]:
+            frozen.append(d)
+        assert live.root_at(size) == frozen.root()
+        assert [p for _, p in live.peaks_at(size)] == \
+            [p for _, p in frozen.peaks()]
+        for idx in range(size):
+            assert live.prove_at(idx, size).aunts == frozen.prove(idx).aunts
+
+
+def test_resume_or_new_without_file(tmp_path):
+    m = resume_or_new(str(tmp_path / "missing.state"), lambda h: None)
+    assert m.size == 0
+    m2 = resume_or_new(None, lambda h: None)
+    assert m2.size == 0
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = MMR()
+    for i in range(13):
+        m.append(bytes([i]) * 32)
+    path = str(tmp_path / "m.state")
+    save_state(m, path)
+    m2 = load_state(path)
+    assert m2.size == 13 and m2.root() == m.root()
+    assert m2.prove(5).aunts == m.prove(5).aunts
+    open(path, "wb").write(b"not an mmr")
+    with pytest.raises(MMRStateError):
+        load_state(path)

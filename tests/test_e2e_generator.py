@@ -71,7 +71,8 @@ def test_full_profile_reaches_every_dimension():
         assert any(n["key_type"] == kt for n in nodes), kt
     for p in ("kill", "pause", "disconnect", "restart", "backend_faults",
               "concurrent_light_clients", "tx_flood", "vote_batch",
-              "light_gateway", "mixed_load", "recv_flood"):
+              "light_gateway", "mixed_load", "recv_flood",
+              "bundle_cold_sync"):
         assert any(p in n["perturb"] for n in nodes), p
 
 

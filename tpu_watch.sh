@@ -62,7 +62,7 @@ for i in $(seq 1 1400); do
     if [ "$rc" = "0" ] && grep -q '"platform"' tpu_bench.out && \
        ! grep -q '"platform": "cpu' tpu_bench.out; then
       grep '"metric"' tpu_bench.out | tail -1 > tpu_bench_latest.json
-      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine + fanout + recvq stages ride in the
+      # The coalesce + ingress + hotpath + lightgw + mesh + sidecar + engine + fanout + recvq + bundle stages ride in the
       # carried JSON (host-side scheduler/admission/vote-batching/gateway
       # speedups measured while the device was serving); surface them in
       # the history. None gates alt-mode adoption below. Helper python is
@@ -122,6 +122,12 @@ parts.append(
     f"{rq['demux_p95_ms']}ms"
     + (" order-identical" if rq.get("order_identical") else "")
     if rq else "recvq absent")
+bu = rec.get("stages", {}).get("bundle")
+parts.append(
+    f"bundle {bu['round_trips_vs_proof']}x trips "
+    f"{bu['wire_bytes_vs_proof']}x bytes {bu['bundle_bytes']}B"
+    + (" trace-identical" if bu.get("trace_identical") else "")
+    if bu else "bundle absent")
 bz = rec.get("stages", {}).get("byz")
 parts.append(
     f"byz ev-commit {bz.get('equivocator_detect_to_commit_s')}sim-s "
